@@ -1,0 +1,23 @@
+"""tendermint_tpu — a TPU-native BFT state-machine-replication framework.
+
+A brand-new implementation of the capabilities of Tendermint Core v0.33.2
+(reference: /root/reference), designed TPU-first:
+
+- The hot path of BFT consensus — ed25519 signature verification for vote
+  aggregation (`consensus/state.go:1751` -> `types/vote_set.go:201` in the
+  reference), commit verification (`types/validator_set.go:629`), light-client
+  trust checks (`lite2/verifier.go:32`), and fast-sync replay
+  (`blockchain/v0/reactor.go:216`) — is re-architected as an async batched
+  verification engine running as a JAX program over an HBM-resident validator
+  pubkey table (see `tendermint_tpu.ops` and `tendermint_tpu.crypto.batch_verifier`).
+- Consensus orchestration, p2p gossip, mempool and storage are asyncio
+  services mirroring the reference's goroutine architecture.
+"""
+
+__version__ = "0.1.0"
+
+# Reference parity: version/version.go:24-30
+TM_CORE_SEMVER = "0.33.2-tpu"
+ABCI_SEMVER = "0.16.2"
+BLOCK_PROTOCOL = 10
+P2P_PROTOCOL = 7

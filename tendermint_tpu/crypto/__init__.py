@@ -1,0 +1,39 @@
+"""Crypto layer.
+
+Counterpart of the reference's `crypto/` package: `PubKey`/`PrivKey`
+interfaces (reference: crypto/crypto.go:22,29), ed25519 (default consensus
+keys), secp256k1, threshold multisig, merkle trees and tmhash.
+
+The defining departure from the reference: `PubKey.verify` remains the
+compatibility interface, but hot callers route through the asynchronous
+TPU `BatchVerifier` (crypto/batch_verifier.py) which runs ed25519
+verification as a JAX program over an HBM-resident pubkey table — the
+reference verifies every signature serially on the CPU
+(crypto/ed25519/ed25519.go:151).
+"""
+
+from .keys import (
+    PubKey,
+    PrivKey,
+    Ed25519PrivKey,
+    Ed25519PubKey,
+    Secp256k1PrivKey,
+    Secp256k1PubKey,
+    pubkey_from_dict,
+    ADDRESS_SIZE,
+)
+from .tmhash import sum_sha256, sum_truncated, TRUNCATED_SIZE
+
+__all__ = [
+    "PubKey",
+    "PrivKey",
+    "Ed25519PrivKey",
+    "Ed25519PubKey",
+    "Secp256k1PrivKey",
+    "Secp256k1PubKey",
+    "pubkey_from_dict",
+    "ADDRESS_SIZE",
+    "sum_sha256",
+    "sum_truncated",
+    "TRUNCATED_SIZE",
+]

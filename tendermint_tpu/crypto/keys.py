@@ -1,0 +1,284 @@
+"""Key types: ed25519 (consensus default) and secp256k1.
+
+Reference parity: `crypto.PubKey`/`PrivKey` interfaces (crypto/crypto.go:22,29),
+ed25519 keys (crypto/ed25519/ed25519.go; address = SHA256(pubkey)[:20],
+ed25519.go:138), secp256k1 keys (crypto/secp256k1/; address =
+RIPEMD160(SHA256(pubkey))).
+
+Host signing/verifying uses the `cryptography` library's C backends; the
+pure-Python math in `ed25519_math` is the differential-test oracle and the
+decompression path for the TPU pubkey table.  Batched verification lives in
+`crypto/batch_verifier.py`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from abc import ABC, abstractmethod
+from typing import Optional
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+from ..encoding.codec import register
+from . import ed25519_math
+from .tmhash import sum_truncated
+
+ADDRESS_SIZE = 20
+
+
+class PubKey(ABC):
+    TYPE: str = ""
+
+    @abstractmethod
+    def address(self) -> bytes: ...
+
+    @abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abstractmethod
+    def verify(self, msg: bytes, sig: bytes) -> bool: ...
+
+    def equals(self, other: "PubKey") -> bool:
+        return type(self) is type(other) and self.bytes() == other.bytes()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PubKey) and self.equals(other)
+
+    def __hash__(self) -> int:
+        return hash((self.TYPE, self.bytes()))
+
+    def to_dict(self) -> dict:
+        return {"type": self.TYPE, "value": self.bytes()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PubKey":
+        return pubkey_from_dict(d)
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.bytes().hex()[:16]}…)"
+
+
+class PrivKey(ABC):
+    TYPE: str = ""
+
+    @abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abstractmethod
+    def sign(self, msg: bytes) -> bytes: ...
+
+    @abstractmethod
+    def pub_key(self) -> PubKey: ...
+
+
+# ---------------------------------------------------------------------------
+# ed25519
+# ---------------------------------------------------------------------------
+
+
+@register("pk/ed25519")
+class Ed25519PubKey(PubKey):
+    TYPE = "tendermint/PubKeyEd25519"
+    SIZE = 32
+    SIG_SIZE = 64
+
+    def __init__(self, data: bytes):
+        if len(data) != self.SIZE:
+            raise ValueError(f"ed25519 pubkey must be {self.SIZE} bytes")
+        self._data = bytes(data)
+        self._handle: Optional[Ed25519PublicKey] = None
+
+    def address(self) -> bytes:
+        # reference crypto/ed25519/ed25519.go:138 — SHA256 truncated to 20B
+        return sum_truncated(self._data)
+
+    def bytes(self) -> bytes:
+        return self._data
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        """Single host verify (compatibility path).
+
+        Hot paths go through crypto.batch_verifier instead; this exists for
+        parity with `VerifyBytes` (crypto/ed25519/ed25519.go:151).
+        """
+        if len(sig) != self.SIG_SIZE:
+            return False
+        # Match x/crypto semantics: reject non-canonical S explicitly (the
+        # cryptography lib also rejects, but keep the check locked in).
+        if not ed25519_math.sc_minimal(sig[32:]):
+            return False
+        try:
+            if self._handle is None:
+                self._handle = Ed25519PublicKey.from_public_bytes(self._data)
+            self._handle.verify(sig, msg)
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Ed25519PubKey":
+        return cls(d["value"])
+
+
+@register("sk/ed25519")
+class Ed25519PrivKey(PrivKey):
+    TYPE = "tendermint/PrivKeyEd25519"
+    SIZE = 32  # seed
+
+    def __init__(self, seed: bytes):
+        if len(seed) == 64:  # tolerate golang-style seed||pub concatenation
+            seed = seed[:32]
+        if len(seed) != self.SIZE:
+            raise ValueError("ed25519 privkey must be a 32-byte seed")
+        self._seed = bytes(seed)
+        self._handle = Ed25519PrivateKey.from_private_bytes(self._seed)
+        self._pub = Ed25519PubKey(
+            self._handle.public_key().public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw
+            )
+        )
+
+    @classmethod
+    def generate(cls) -> "Ed25519PrivKey":
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_secret(cls, secret: bytes) -> "Ed25519PrivKey":
+        """Deterministic key from a secret (reference GenPrivKeyFromSecret:
+        crypto/ed25519/ed25519.go:106 — SHA256 of the secret as seed)."""
+        return cls(hashlib.sha256(secret).digest())
+
+    def bytes(self) -> bytes:
+        return self._seed
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._handle.sign(msg)
+
+    def pub_key(self) -> Ed25519PubKey:
+        return self._pub
+
+    def to_dict(self) -> dict:
+        return {"type": self.TYPE, "value": self._seed}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Ed25519PrivKey":
+        return cls(d["value"])
+
+
+# ---------------------------------------------------------------------------
+# secp256k1 (ECDSA).  Reference: crypto/secp256k1/secp256k1.go — 33-byte
+# compressed pubkeys, address = RIPEMD160(SHA256(pub)), lower-S signatures
+# (secp256k1_nocgo.go:34 malleability check), 64-byte r||s encoding.
+# ---------------------------------------------------------------------------
+
+_SECP_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+@register("pk/secp256k1")
+class Secp256k1PubKey(PubKey):
+    TYPE = "tendermint/PubKeySecp256k1"
+    SIZE = 33
+
+    def __init__(self, data: bytes):
+        if len(data) != self.SIZE:
+            raise ValueError(f"secp256k1 pubkey must be {self.SIZE} bytes")
+        self._data = bytes(data)
+        self._handle: Optional[ec.EllipticCurvePublicKey] = None
+
+    def address(self) -> bytes:
+        sha = hashlib.sha256(self._data).digest()
+        return hashlib.new("ripemd160", sha).digest()
+
+    def bytes(self) -> bytes:
+        return self._data
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != 64:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if s > _SECP_N // 2:  # reject malleable high-S, parity with reference
+            return False
+        try:
+            if self._handle is None:
+                self._handle = ec.EllipticCurvePublicKey.from_encoded_point(
+                    ec.SECP256K1(), self._data
+                )
+            der = encode_dss_signature(r, s)
+            self._handle.verify(der, msg, ec.ECDSA(hashes.SHA256()))
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Secp256k1PubKey":
+        return cls(d["value"])
+
+
+@register("sk/secp256k1")
+class Secp256k1PrivKey(PrivKey):
+    TYPE = "tendermint/PrivKeySecp256k1"
+    SIZE = 32
+
+    def __init__(self, data: bytes):
+        if len(data) != self.SIZE:
+            raise ValueError("secp256k1 privkey must be 32 bytes")
+        self._data = bytes(data)
+        self._handle = ec.derive_private_key(
+            int.from_bytes(self._data, "big"), ec.SECP256K1()
+        )
+        pub = self._handle.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+        )
+        self._pub = Secp256k1PubKey(pub)
+
+    @classmethod
+    def generate(cls) -> "Secp256k1PrivKey":
+        k = ec.generate_private_key(ec.SECP256K1())
+        return cls(k.private_numbers().private_value.to_bytes(32, "big"))
+
+    def bytes(self) -> bytes:
+        return self._data
+
+    def sign(self, msg: bytes) -> bytes:
+        der = self._handle.sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > _SECP_N // 2:  # normalize to lower-S
+            s = _SECP_N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> Secp256k1PubKey:
+        return self._pub
+
+    def to_dict(self) -> dict:
+        return {"type": self.TYPE, "value": self._data}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Secp256k1PrivKey":
+        return cls(d["value"])
+
+
+# ---------------------------------------------------------------------------
+
+
+def pubkey_from_dict(d: dict) -> PubKey:
+    t = d.get("type")
+    for cls in (Ed25519PubKey, Secp256k1PubKey):
+        if t == cls.TYPE:
+            return cls(d["value"])
+    from .multisig import MultisigThresholdPubKey  # cyclic at import time
+
+    if t == MultisigThresholdPubKey.TYPE:
+        return MultisigThresholdPubKey.from_dict(d)
+    raise ValueError(f"unknown pubkey type {t!r}")

@@ -1,0 +1,38 @@
+"""Deterministic encodings.
+
+The reference serializes everything with go-amino (a reflection-based,
+proto3-compatible codec — reference: go.mod `go-amino v0.14.1`, per-package
+`codec.go` files).  This framework splits the two concerns amino conflated:
+
+- **Canonical encoding** (`tendermint_tpu.encoding.canonical` helpers here):
+  hand-written proto3-style field encoding used wherever bytes are hashed or
+  signed (sign-bytes, merkle leaves).  Deterministic by construction.
+- **Transport encoding**: msgpack of explicit dicts for p2p/WAL/storage
+  (see `tendermint_tpu.encoding.codec`), where only round-tripping matters.
+"""
+
+from .varint import encode_uvarint, decode_uvarint, encode_svarint, decode_svarint
+from .proto import (
+    field_varint,
+    field_bytes,
+    field_fixed64,
+    length_prefixed,
+    field_time,
+)
+from .codec import register, dumps, loads, Codec
+
+__all__ = [
+    "encode_uvarint",
+    "decode_uvarint",
+    "encode_svarint",
+    "decode_svarint",
+    "field_varint",
+    "field_bytes",
+    "field_fixed64",
+    "field_time",
+    "length_prefixed",
+    "register",
+    "dumps",
+    "loads",
+    "Codec",
+]

@@ -1,0 +1,102 @@
+"""Self-rotating append-only file group — the WAL substrate.
+
+TPU-native counterpart of the reference's `libs/autofile`
+(reference: libs/autofile/group.go): an append-only head file plus rotated
+chunks ``<path>.000``, ``<path>.001``… rotated when the head exceeds
+`head_size_limit`; total size bounded by `group_size_limit` by deleting the
+oldest chunks.  Synchronous file IO is used (called from the consensus task
+via asyncio.to_thread when latency matters).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterator, Optional
+
+
+class Group:
+    def __init__(
+        self,
+        head_path: str,
+        head_size_limit: int = 10 * 1024 * 1024,
+        group_size_limit: int = 0,  # 0 = unlimited
+    ):
+        self.head_path = head_path
+        self.head_size_limit = head_size_limit
+        self.group_size_limit = group_size_limit
+        os.makedirs(os.path.dirname(head_path) or ".", exist_ok=True)
+        self._head = open(head_path, "ab")
+
+    # -- index bookkeeping -------------------------------------------------
+    def _chunk_path(self, idx: int) -> str:
+        return f"{self.head_path}.{idx:03d}"
+
+    def chunk_indices(self) -> list[int]:
+        d = os.path.dirname(self.head_path) or "."
+        base = os.path.basename(self.head_path)
+        pat = re.compile(re.escape(base) + r"\.(\d{3,})$")
+        out = []
+        for name in os.listdir(d):
+            m = pat.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    # -- writing ------------------------------------------------------------
+    def write(self, data: bytes) -> None:
+        self._head.write(data)
+
+    def flush(self) -> None:
+        self._head.flush()
+
+    def sync(self) -> None:
+        """flush + fsync — the WAL's WriteSync discipline
+        (reference consensus/wal.go:201)."""
+        self._head.flush()
+        os.fsync(self._head.fileno())
+
+    def maybe_rotate(self) -> None:
+        if self._head.tell() < self.head_size_limit:
+            return
+        self.rotate()
+
+    def rotate(self) -> None:
+        self._head.close()
+        indices = self.chunk_indices()
+        nxt = (indices[-1] + 1) if indices else 0
+        os.rename(self.head_path, self._chunk_path(nxt))
+        self._head = open(self.head_path, "ab")
+        self._enforce_group_limit()
+
+    def _enforce_group_limit(self) -> None:
+        if self.group_size_limit <= 0:
+            return
+        while True:
+            indices = self.chunk_indices()
+            total = sum(os.path.getsize(self._chunk_path(i)) for i in indices)
+            total += os.path.getsize(self.head_path)
+            if total <= self.group_size_limit or not indices:
+                return
+            os.remove(self._chunk_path(indices[0]))
+
+    # -- reading ------------------------------------------------------------
+    def reader(self) -> Iterator[bytes]:
+        """Yield raw byte chunks from oldest chunk through the head."""
+        self._head.flush()
+        for i in self.chunk_indices():
+            with open(self._chunk_path(i), "rb") as f:
+                yield f.read()
+        with open(self.head_path, "rb") as f:
+            yield f.read()
+
+    def read_all(self) -> bytes:
+        return b"".join(self.reader())
+
+    def head_size(self) -> int:
+        return self._head.tell()
+
+    def close(self) -> None:
+        if not self._head.closed:
+            self._head.flush()
+            self._head.close()

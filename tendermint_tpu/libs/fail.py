@@ -1,0 +1,33 @@
+"""Fail-point injection for crash-recovery testing.
+
+TPU-native counterpart of the reference's `libs/fail`
+(reference: libs/fail/fail.go:27): a process-wide counter of fail points;
+when the environment variable ``FAIL_TEST_INDEX`` equals the current call
+index the process exits hard, letting the persistence test rig
+(reference: test/persist/test_failure_indices.sh) assert WAL/handshake
+recovery at every crash site.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_call_index = -1
+
+
+def reset() -> None:
+    global _call_index
+    _call_index = -1
+
+
+def fail() -> None:
+    global _call_index
+    env = os.environ.get("FAIL_TEST_INDEX")
+    if env is None:
+        return
+    _call_index += 1
+    if _call_index == int(env):
+        sys.stderr.write(f"*** fail-point {_call_index} tripped — exiting\n")
+        sys.stderr.flush()
+        os._exit(1)

@@ -1,0 +1,110 @@
+"""Service lifecycle template.
+
+TPU-native counterpart of the reference's universal composition pattern
+`service.Service` / `BaseService` (reference: libs/service/service.go) —
+every reactor, the node, the WAL and the event bus share one
+Start/Stop/Quit lifecycle.  Here the template is an asyncio-friendly class:
+`on_start` may spawn asyncio tasks that are tracked and cancelled on stop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Coroutine, Optional
+
+
+class AlreadyStartedError(RuntimeError):
+    pass
+
+
+class AlreadyStoppedError(RuntimeError):
+    pass
+
+
+class Service:
+    """Start/Stop/Quit lifecycle with on_start/on_stop template methods.
+
+    Mirrors the semantics of the reference BaseService
+    (libs/service/service.go:99): Start is idempotent-error (starting twice
+    raises), Stop cancels spawned tasks and fires `wait_stopped`.
+    """
+
+    def __init__(self, name: str = ""):
+        self._name = name or type(self).__name__
+        self._started = False
+        self._stopped = False
+        self._quit: Optional[asyncio.Event] = None
+        self._tasks: list[asyncio.Task] = []
+        self.logger = logging.getLogger(self._name)
+
+    # -- template methods -------------------------------------------------
+    async def on_start(self) -> None:  # override
+        pass
+
+    async def on_stop(self) -> None:  # override
+        pass
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def is_running(self) -> bool:
+        return self._started and not self._stopped
+
+    async def start(self) -> None:
+        if self._started:
+            raise AlreadyStartedError(self._name)
+        if self._stopped:
+            raise AlreadyStoppedError(self._name)
+        self._quit = asyncio.Event()
+        self._started = True
+        self.logger.debug("service starting")
+        await self.on_start()
+
+    async def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        self.logger.debug("service stopping")
+        try:
+            await self.on_stop()
+        finally:
+            for t in self._tasks:
+                t.cancel()
+            for t in self._tasks:
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+            self._tasks.clear()
+            if self._quit is not None:
+                self._quit.set()
+
+    def spawn(self, coro: Coroutine, name: str = "") -> asyncio.Task:
+        """Spawn a task owned by this service; cancelled on stop.
+
+        The tracked-task pattern replaces the reference's per-service
+        goroutines + WaitGroups.
+        """
+        task = asyncio.get_event_loop().create_task(coro, name=name or self._name)
+        self._tasks.append(task)
+        task.add_done_callback(self._on_task_done)
+        return task
+
+    def _on_task_done(self, task: asyncio.Task) -> None:
+        try:
+            self._tasks.remove(task)
+        except ValueError:
+            pass
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None and not self._stopped:
+            self.logger.error("task %s crashed: %r", task.get_name(), exc)
+
+    async def wait_stopped(self) -> None:
+        if self._quit is not None:
+            await self._quit.wait()

@@ -1,0 +1,48 @@
+"""Test configuration.
+
+Tests run JAX on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (real-chip execution is covered by bench.py
+and the driver's dryrun).  Environment must be set before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def event_loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def pytest_collection_modifyitems(config, items):
+    # Provide asyncio support without the pytest-asyncio plugin: run
+    # coroutine tests on a fresh event loop.
+    pass
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    import inspect
+
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(asyncio.wait_for(func(**kwargs), timeout=120))
+        finally:
+            loop.close()
+        return True
+    return None

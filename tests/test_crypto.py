@@ -1,0 +1,134 @@
+"""Crypto tests: ed25519 host+reference paths, secp256k1, multisig, merkle."""
+
+import hashlib
+import os
+
+from tendermint_tpu.crypto import ed25519_math as em
+from tendermint_tpu.crypto.keys import (
+    Ed25519PrivKey,
+    Ed25519PubKey,
+    Secp256k1PrivKey,
+    pubkey_from_dict,
+)
+from tendermint_tpu.crypto.merkle import (
+    hash_from_byte_slices,
+    proofs_from_byte_slices,
+)
+from tendermint_tpu.crypto.multisig import (
+    MultisigThresholdPubKey,
+    build_multisig_signature,
+)
+from tendermint_tpu.libs.bitarray import BitArray
+
+
+def test_ed25519_sign_verify():
+    priv = Ed25519PrivKey.from_secret(b"seed")
+    pub = priv.pub_key()
+    msg = b"hello tendermint"
+    sig = priv.sign(msg)
+    assert pub.verify(msg, sig)
+    assert not pub.verify(msg + b"!", sig)
+    assert not pub.verify(msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+    assert len(pub.address()) == 20
+    assert pub.address() == hashlib.sha256(pub.bytes()).digest()[:20]
+
+
+def test_ed25519_pure_python_matches_host():
+    priv = Ed25519PrivKey.from_secret(b"oracle")
+    pub = priv.pub_key()
+    for i in range(5):
+        msg = os.urandom(40) + bytes([i])
+        sig = priv.sign(msg)
+        assert em.verify(pub.bytes(), msg, sig)
+        bad = bytearray(sig)
+        bad[0] ^= 1
+        assert not em.verify(pub.bytes(), msg, bytes(bad))
+        assert em.verify(pub.bytes(), msg, sig) == pub.verify(msg, sig)
+
+
+def test_ed25519_decompress_roundtrip():
+    priv = Ed25519PrivKey.generate()
+    pt = em.decompress(priv.pub_key().bytes())
+    assert pt is not None
+    x, y = pt
+    assert em.compress(x, y) == priv.pub_key().bytes()
+    # on-curve check: -x^2 + y^2 = 1 + d x^2 y^2
+    lhs = (-x * x + y * y) % em.P
+    rhs = (1 + em.D * x * x % em.P * y * y) % em.P
+    assert lhs == rhs
+
+
+def test_ed25519_noncanonical_s_rejected():
+    priv = Ed25519PrivKey.from_secret(b"s-check")
+    pub = priv.pub_key()
+    msg = b"msg"
+    sig = priv.sign(msg)
+    s = int.from_bytes(sig[32:], "little")
+    bad_s = (s + em.L).to_bytes(32, "little")  # same point, non-canonical
+    assert not pub.verify(msg, sig[:32] + bad_s)
+    assert not em.verify(pub.bytes(), msg, sig[:32] + bad_s)
+
+
+def test_double_scalar_mult_matches_naive():
+    A = em.scalar_mult(12345, em.BASE)
+    got = em.double_scalar_mult(7, A, 9)
+    want = em.point_add(em.scalar_mult(7, A), em.scalar_mult(9, em.BASE))
+    assert em.to_affine(got) == em.to_affine(want)
+
+
+def test_secp256k1():
+    priv = Secp256k1PrivKey.generate()
+    pub = priv.pub_key()
+    msg = b"abc transaction"
+    sig = priv.sign(msg)
+    assert len(sig) == 64
+    assert pub.verify(msg, sig)
+    assert not pub.verify(b"other", sig)
+    assert len(pub.address()) == 20
+    # high-S rejected
+    from tendermint_tpu.crypto.keys import _SECP_N
+
+    s = int.from_bytes(sig[32:], "big")
+    high = _SECP_N - s
+    assert not pub.verify(msg, sig[:32] + high.to_bytes(32, "big"))
+
+
+def test_multisig_threshold():
+    privs = [Ed25519PrivKey.from_secret(bytes([i])) for i in range(4)]
+    pub = MultisigThresholdPubKey(2, [p.pub_key() for p in privs])
+    msg = b"multisig msg"
+    bits = BitArray.from_indices(4, [1, 3])
+    sigs = [privs[1].sign(msg), privs[3].sign(msg)]
+    sig = build_multisig_signature(bits, sigs)
+    assert pub.verify(msg, sig)
+    # below threshold
+    bits1 = BitArray.from_indices(4, [1])
+    assert not pub.verify(msg, build_multisig_signature(bits1, [sigs[0]]))
+    # wrong signer position
+    bits2 = BitArray.from_indices(4, [0, 3])
+    assert not pub.verify(msg, build_multisig_signature(bits2, sigs))
+    # roundtrip through dict
+    pub2 = pubkey_from_dict(pub.to_dict())
+    assert pub2.verify(msg, sig)
+    assert pub2.address() == pub.address()
+
+
+def test_merkle_root_and_proofs():
+    items = [b"a", b"b", b"c", b"d", b"e"]
+    root = hash_from_byte_slices(items)
+    root2, proofs = proofs_from_byte_slices(items)
+    assert root == root2
+    for i, p in enumerate(proofs):
+        assert p.verify(root, items[i])
+        assert not p.verify(root, b"wrong")
+    # empty & single
+    assert hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+    r1, p1 = proofs_from_byte_slices([b"only"])
+    assert p1[0].verify(r1, b"only")
+
+
+def test_merkle_known_structure():
+    # two leaves: root = inner(leaf(a), leaf(b))
+    la = hashlib.sha256(b"\x00a").digest()
+    lb = hashlib.sha256(b"\x00b").digest()
+    assert hash_from_byte_slices([b"a", b"b"]) == hashlib.sha256(b"\x01" + la + lb).digest()

@@ -1,0 +1,146 @@
+"""Tests for libs: service, bitarray, events/query, autofile, encoding."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.encoding import (
+    decode_svarint,
+    decode_uvarint,
+    encode_svarint,
+    encode_uvarint,
+    dumps,
+    loads,
+)
+from tendermint_tpu.libs.autofile import Group
+from tendermint_tpu.libs.bitarray import BitArray
+from tendermint_tpu.libs.events import PubSubServer, Query
+from tendermint_tpu.libs.service import AlreadyStartedError, Service
+
+
+# -- varint -----------------------------------------------------------------
+
+def test_varint_roundtrip():
+    for n in [0, 1, 127, 128, 300, 2**32, 2**63 - 1]:
+        enc = encode_uvarint(n)
+        dec, off = decode_uvarint(enc)
+        assert dec == n and off == len(enc)
+    for n in [0, -1, 1, -64, 63, -(2**31), 2**31]:
+        enc = encode_svarint(n)
+        dec, off = decode_svarint(enc)
+        assert dec == n and off == len(enc)
+
+
+# -- bitarray ---------------------------------------------------------------
+
+def test_bitarray_basics():
+    ba = BitArray(10)
+    assert ba.is_empty() and not ba.is_full()
+    ba.set_index(3, True)
+    ba.set_index(9, True)
+    assert ba.get_index(3) and not ba.get_index(4)
+    assert ba.count() == 2
+    assert ba.true_indices() == [3, 9]
+    assert not ba.set_index(10, True)  # out of range
+    b2 = BitArray.from_indices(10, [3, 4])
+    assert ba.or_(b2).true_indices() == [3, 4, 9]
+    assert ba.and_(b2).true_indices() == [3]
+    assert ba.sub(b2).true_indices() == [9]
+    rt = BitArray.from_bytes(ba.to_bytes())
+    assert rt == ba
+    assert ba.pick_random() in (3, 9)
+
+
+# -- query language ---------------------------------------------------------
+
+def test_query_parse_and_match():
+    q = Query.parse("tm.event='NewBlock' AND tx.height>5")
+    assert q.matches({"tm.event": ["NewBlock"], "tx.height": ["7"]})
+    assert not q.matches({"tm.event": ["NewBlock"], "tx.height": ["5"]})
+    assert not q.matches({"tm.event": ["Tx"], "tx.height": ["7"]})
+    q2 = Query.parse("account.name CONTAINS 'igor'")
+    assert q2.matches({"account.name": ["igor bogatov"]})
+    q3 = Query.parse("tx.hash EXISTS")
+    assert q3.matches({"tx.hash": ["ABC"]})
+    assert not q3.matches({})
+    q4 = Query.parse("tx.height <= 10 AND tx.height >= 3")
+    assert q4.matches({"tx.height": ["3"]})
+    assert q4.matches({"tx.height": ["10"]})
+    assert not q4.matches({"tx.height": ["11"]})
+
+
+async def test_pubsub():
+    srv = PubSubServer()
+    await srv.start()
+    sub = await srv.subscribe("client1", "tm.event='Tx'")
+    await srv.publish({"n": 1}, {"tm.event": ["Tx"]})
+    await srv.publish({"n": 2}, {"tm.event": ["NewBlock"]})
+    await srv.publish({"n": 3}, {"tm.event": ["Tx"]})
+    m1 = await sub.next()
+    m2 = await sub.next()
+    assert m1.data == {"n": 1} and m2.data == {"n": 3}
+    await srv.unsubscribe_all("client1")
+    assert sub.cancelled
+    await srv.stop()
+
+
+async def test_pubsub_slow_client_cancelled():
+    srv = PubSubServer(buffer=2)
+    await srv.start()
+    sub = await srv.subscribe("slow", "tm.event='Tx'")
+    for i in range(3):
+        await srv.publish(i, {"tm.event": ["Tx"]})
+    assert sub.cancelled and sub.cancel_reason == "out of capacity"
+    await srv.stop()
+
+
+# -- service ----------------------------------------------------------------
+
+async def test_service_lifecycle():
+    events = []
+
+    class S(Service):
+        async def on_start(self):
+            events.append("start")
+            self.spawn(self._run())
+
+        async def _run(self):
+            await asyncio.sleep(100)
+
+        async def on_stop(self):
+            events.append("stop")
+
+    s = S("test")
+    await s.start()
+    assert s.is_running
+    with pytest.raises(AlreadyStartedError):
+        await s.start()
+    await s.stop()
+    assert not s.is_running
+    assert events == ["start", "stop"]
+    await s.wait_stopped()
+
+
+# -- autofile ---------------------------------------------------------------
+
+def test_autofile_rotation(tmp_path):
+    g = Group(str(tmp_path / "wal"), head_size_limit=100)
+    for i in range(10):
+        g.write(b"x" * 30)
+        g.maybe_rotate()
+    g.sync()
+    assert g.chunk_indices()  # rotated at least once
+    data = g.read_all()
+    assert data == b"x" * 300
+    g.close()
+
+
+# -- codec ------------------------------------------------------------------
+
+def test_codec_roundtrip():
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+
+    pk = Ed25519PrivKey.from_secret(b"test").pub_key()
+    out = loads(dumps({"key": pk, "n": 5}))
+    assert out["n"] == 5
+    assert out["key"] == pk
