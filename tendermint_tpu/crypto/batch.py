@@ -1,0 +1,47 @@
+"""Pluggable batch-verification hook.
+
+The TPU design inversion (SURVEY.md §7, BASELINE north star): every hot
+caller of per-signature verification in the reference — VerifyCommit
+(types/validator_set.go:641-668), VoteSet.AddVote (types/vote_set.go:201),
+lite2 VerifyCommitTrusting (types/validator_set.go:754), fast-sync replay —
+is re-expressed as "verify this whole batch of (pubkey, msg, sig) at once".
+
+This module owns the indirection: `get_verifier()` returns a callable
+``verify(pubkeys, msgs, sigs) -> list[bool]``.  The default is a host-CPU
+path; the JAX/TPU engine (crypto/batch_verifier.py) installs itself via
+`set_verifier` at node startup.  Semantics are identical either way: one
+boolean per triple, no early exit (whole-batch check is the TPU win).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+BatchVerifyFn = Callable[[Sequence[bytes], Sequence[bytes], Sequence[bytes]], List[bool]]
+
+_verifier: Optional[BatchVerifyFn] = None
+
+
+def host_batch_verify(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> List[bool]:
+    """Serial host fallback over the C ed25519 backend — the compatibility
+    baseline the TPU engine is benchmarked against."""
+    from .keys import Ed25519PubKey
+
+    out = []
+    for pk, msg, sig in zip(pubkeys, msgs, sigs):
+        try:
+            out.append(Ed25519PubKey(pk).verify(msg, sig))
+        except ValueError:
+            out.append(False)
+    return out
+
+
+def get_verifier() -> BatchVerifyFn:
+    return _verifier if _verifier is not None else host_batch_verify
+
+
+def set_verifier(fn: Optional[BatchVerifyFn]) -> None:
+    global _verifier
+    _verifier = fn

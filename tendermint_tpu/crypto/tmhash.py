@@ -13,5 +13,8 @@ def sum_sha256(data: bytes) -> bytes:
     return hashlib.sha256(data).digest()
 
 
+sum = sum_sha256  # reference name: tmhash.Sum
+
+
 def sum_truncated(data: bytes) -> bytes:
     return hashlib.sha256(data).digest()[:TRUNCATED_SIZE]

@@ -1,0 +1,58 @@
+"""Domain types: blocks, votes, validators, commits, evidence, genesis.
+
+TPU-native counterpart of the reference `types/` package.  The key design
+inversion (SURVEY.md §7): commit/vote verification is expressed as batch
+verification over (pubkey, msg, sig) triples so the crypto engine can run
+them as one vmapped TPU kernel instead of the reference's serial loop
+(types/validator_set.go:641-668).
+"""
+
+from .params import (
+    BlockParams,
+    ConsensusParams,
+    EvidenceParams,
+    ValidatorParams,
+    BLOCK_PART_SIZE_BYTES,
+    MAX_BLOCK_SIZE_BYTES,
+)
+from .canonical import (
+    PREVOTE_TYPE,
+    PRECOMMIT_TYPE,
+    PROPOSAL_TYPE,
+    canonical_vote_sign_bytes,
+    canonical_proposal_sign_bytes,
+    is_vote_type_valid,
+)
+from .block import (
+    BlockID,
+    PartSetHeader,
+    Header,
+    CommitSig,
+    Commit,
+    Block,
+    SignedHeader,
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+)
+from .vote import (
+    Vote,
+    VoteError,
+    ErrVoteConflictingVotes,
+)
+from .proposal import Proposal
+from .validator import (
+    Validator,
+    ValidatorSet,
+    MAX_TOTAL_VOTING_POWER,
+    NotEnoughVotingPowerError,
+)
+from .vote_set import VoteSet
+from .part_set import Part, PartSet
+from .evidence import DuplicateVoteEvidence, Evidence, evidence_hash
+from .tx import tx_hash, txs_hash, TxProof, tx_proof, ABCIResult, results_hash
+from .genesis import GenesisDoc, GenesisValidator
+from .priv_validator import PrivValidator, MockPV
+from .events import EventBus, Event
+
+__all__ = [n for n in dir() if not n.startswith("_")]

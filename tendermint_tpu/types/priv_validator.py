@@ -1,0 +1,55 @@
+"""PrivValidator interface + test mock.
+
+Reference parity: types/priv_validator.go:14 (GetPubKey/SignVote/
+SignProposal), MockPV:33.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from ..crypto.keys import Ed25519PrivKey, PubKey
+from .proposal import Proposal
+from .vote import Vote
+
+
+class PrivValidator(ABC):
+    """Signs votes and proposals, never double-signs."""
+
+    @abstractmethod
+    def get_pub_key(self) -> PubKey: ...
+
+    @abstractmethod
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        """Sets vote.signature in place (reference mutates the same way)."""
+
+    @abstractmethod
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None: ...
+
+
+class MockPV(PrivValidator):
+    """In-memory signer for tests (types/priv_validator.go:33).
+    `break_*` flags corrupt sign-bytes for byzantine tests
+    (erroringMockPV equivalents)."""
+
+    def __init__(self, priv_key: Ed25519PrivKey | None = None, break_proposal_signing: bool = False, break_vote_signing: bool = False):
+        self.priv_key = priv_key or Ed25519PrivKey.generate()
+        self.break_proposal_signing = break_proposal_signing
+        self.break_vote_signing = break_vote_signing
+
+    def get_pub_key(self) -> PubKey:
+        return self.priv_key.pub_key()
+
+    def address(self) -> bytes:
+        return self.get_pub_key().address()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        use_chain_id = "incorrect-chain-id" if self.break_vote_signing else chain_id
+        vote.signature = self.priv_key.sign(vote.sign_bytes(use_chain_id))
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        use_chain_id = "incorrect-chain-id" if self.break_proposal_signing else chain_id
+        proposal.signature = self.priv_key.sign(proposal.sign_bytes(use_chain_id))
+
+    def __repr__(self) -> str:
+        return f"MockPV({self.address().hex()[:12]})"
