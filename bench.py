@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Benchmark: batched ed25519 verification, TPU vs host-CPU serial path.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value       — batch-verified signatures/sec on the default JAX device
+              (10k-validator commit batch — BASELINE.json config #5 scale).
+vs_baseline — speedup over the reference's architecture: one-at-a-time
+              host verification (crypto/ed25519/ed25519.go:151 VerifyBytes
+              inside the types/validator_set.go:641-668 loop), measured
+              here with the same C ed25519 backend.
+"""
+
+import json
+import time
+
+
+def main() -> None:
+    from tendermint_tpu.crypto.batch_verifier import BatchVerifier, PubkeyTable
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey, Ed25519PubKey
+
+    n_vals = 10_000
+    keys = [Ed25519PrivKey.from_secret(b"bench-%d" % i) for i in range(n_vals)]
+    pubkeys = [k.pub_key().bytes() for k in keys]
+    # one commit's worth of votes: same message modulo timestamp (fixed
+    # per-commit layout), one sig per validator
+    msgs = [b"\x08\x02\x11" + i.to_bytes(8, "little") + b"commit-sign-bytes" * 5 for i in range(n_vals)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+
+    # --- TPU/batched path: pubkey table resident on device ----------------
+    table = PubkeyTable(pubkeys, BatchVerifier())
+    idxs = list(range(n_vals))
+    # warmup (compile)
+    table.verify_indexed(idxs, msgs, sigs)
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ok = table.verify_indexed(idxs, msgs, sigs)
+    dt = (time.perf_counter() - t0) / reps
+    assert all(ok), "bench batch failed to verify"
+    batched_sigs_per_sec = n_vals / dt
+
+    # --- baseline: serial host verification (reference architecture) -----
+    sample = 512
+    pks = [Ed25519PubKey(pk) for pk in pubkeys[:sample]]
+    t0 = time.perf_counter()
+    for pk, m, s in zip(pks, msgs[:sample], sigs[:sample]):
+        assert pk.verify(m, s)
+    serial_dt = time.perf_counter() - t0
+    serial_sigs_per_sec = sample / serial_dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_batch_verify_10k_val_commit",
+                "value": round(batched_sigs_per_sec, 1),
+                "unit": "sigs/sec/chip",
+                "vs_baseline": round(batched_sigs_per_sec / serial_sigs_per_sec, 3),
+                "detail": {
+                    "batch_ms_per_10k_commit": round(dt * 1000, 2),
+                    "serial_host_sigs_per_sec": round(serial_sigs_per_sec, 1),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

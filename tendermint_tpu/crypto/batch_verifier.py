@@ -1,0 +1,339 @@
+"""TPU batch verifier: the framework's north-star engine.
+
+Replaces the reference's serial per-signature verification
+(crypto/ed25519/ed25519.go:151 called from types/vote_set.go:201,
+types/validator_set.go:641-668, lite2/verifier.go:32,
+blockchain/v0/reactor.go:216 replay, mempool CheckTx) with one vmapped
+curve kernel over an HBM-resident pubkey table.
+
+Split of labor:
+  host   — pubkey decompression (cached; table built once per validator
+           set), SHA-512 h = H(R‖A‖M), reduction mod L, structural
+           prefilters (length, canonical S).  These are ~1% of the CPU cost
+           of a verify; the expensive double-scalar multiplication is 99%.
+  device — [s]B + [h](−A) for the whole batch (ops/ed25519.py).
+
+Batches are padded to power-of-two buckets so XLA compiles a handful of
+shapes once; with a `jax.sharding.Mesh` the batch axis is sharded across
+chips (data-parallel over signatures — the system's scale axis per
+SURVEY.md §5 long-context note).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..libs.service import Service
+from . import batch as batch_hook
+from . import ed25519_math as em
+
+_MIN_BUCKET = 16
+
+
+def _bucket_size(n: int, multiple_of: int = 1) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    if b % multiple_of:
+        b = ((b + multiple_of - 1) // multiple_of) * multiple_of
+    return b
+
+
+# ---------------------------------------------------------------------------
+# host-side preparation
+# ---------------------------------------------------------------------------
+
+_N_LIMBS = 15
+_LIMB_BITS = 17
+
+_decompress_cache: Dict[bytes, Optional[np.ndarray]] = {}
+
+
+def _neg_a_limbs(pubkey: bytes) -> Optional[np.ndarray]:
+    """Decompress pubkey and return extended coords of −A as [4, 15] int64
+    limbs; None for invalid encodings.  Cached — the pubkey table is hot."""
+    cached = _decompress_cache.get(pubkey)
+    if cached is not None or pubkey in _decompress_cache:
+        return cached
+    aff = em.decompress(pubkey)
+    if aff is None:
+        _decompress_cache[pubkey] = None
+        return None
+    x, y = aff
+    nx = (em.P - x) % em.P
+    ext = (nx, y, 1, nx * y % em.P)
+    limbs = np.zeros((4, _N_LIMBS), dtype=np.int64)
+    for c in range(4):
+        v = ext[c]
+        for i in range(_N_LIMBS):
+            limbs[c, i] = (v >> (_LIMB_BITS * i)) & ((1 << _LIMB_BITS) - 1)
+    _decompress_cache[pubkey] = limbs
+    return limbs
+
+
+def _msb_bits(values_be: np.ndarray) -> np.ndarray:
+    """[B, 32] big-endian byte rows -> [B, 256] MSB-first bits."""
+    return np.unpackbits(values_be, axis=1).astype(np.int64)
+
+
+def _r_limbs_and_sign(r_bytes: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """[B, 32] little-endian R rows -> raw y limbs [B, 15] + sign bit [B]."""
+    bits = np.unpackbits(r_bytes, axis=1, bitorder="little").astype(np.int64)
+    sign = bits[:, 255].copy()
+    y_bits = bits[:, :255]
+    pow2 = 1 << np.arange(_LIMB_BITS, dtype=np.int64)
+    limbs = np.zeros((r_bytes.shape[0], _N_LIMBS), dtype=np.int64)
+    for j in range(_N_LIMBS):
+        chunk = y_bits[:, j * _LIMB_BITS : (j + 1) * _LIMB_BITS]
+        limbs[:, j] = chunk @ pow2[: chunk.shape[1]]
+    return limbs, sign
+
+
+def prepare_batch(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Host prep: returns (neg_a [B,4,15], h_bits [B,256], s_bits [B,256],
+    r_y_raw [B,15], r_sign [B], valid [B])."""
+    n = len(sigs)
+    neg_a = np.zeros((n, 4, _N_LIMBS), dtype=np.int64)
+    neg_a[:, 1, :1] = 1  # identity placeholder (0,1,1,0): y=z=1
+    neg_a[:, 2, :1] = 1
+    h_be = np.zeros((n, 32), dtype=np.uint8)
+    s_be = np.zeros((n, 32), dtype=np.uint8)
+    r_le = np.zeros((n, 32), dtype=np.uint8)
+    valid = np.zeros(n, dtype=bool)
+
+    for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
+        if len(sig) != 64 or len(pk) != 32:
+            continue
+        if not em.sc_minimal(sig[32:]):
+            continue
+        limbs = _neg_a_limbs(pk)
+        if limbs is None:
+            continue
+        neg_a[i] = limbs
+        h = em.compute_hram(sig[:32], pk, msg)
+        h_be[i] = np.frombuffer(h.to_bytes(32, "big"), dtype=np.uint8)
+        s = int.from_bytes(sig[32:], "little")
+        s_be[i] = np.frombuffer(s.to_bytes(32, "big"), dtype=np.uint8)
+        r_le[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+        valid[i] = True
+
+    r_y_raw, r_sign = _r_limbs_and_sign(r_le)
+    return neg_a, _msb_bits(h_be), _msb_bits(s_be), r_y_raw, r_sign, valid
+
+
+# ---------------------------------------------------------------------------
+# the verifier
+# ---------------------------------------------------------------------------
+
+
+class BatchVerifier:
+    """Batched ed25519 verification, jitted per bucket shape.
+
+    With `mesh`, inputs/outputs are sharded over the batch axis
+    (data-parallel signatures across TPU chips over ICI).
+    """
+
+    def __init__(self, mesh=None, batch_axis: str = "batch"):
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self._fn = None
+
+    def _jitted(self):
+        if self._fn is None:
+            import jax
+
+            from ..ops import ed25519_kernel
+
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                data = NamedSharding(self.mesh, P(self.batch_axis))
+                repl = NamedSharding(self.mesh, P())
+                self._fn = jax.jit(
+                    ed25519_kernel.verify_prepared,
+                    in_shardings=(data, data, data, data, data),
+                    out_shardings=data,
+                )
+            else:
+                self._fn = jax.jit(ed25519_kernel.verify_prepared)
+        return self._fn
+
+    def _pad_multiple(self) -> int:
+        if self.mesh is None:
+            return 1
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    def verify(
+        self, pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+    ) -> List[bool]:
+        n = len(sigs)
+        if n == 0:
+            return []
+        neg_a, h_bits, s_bits, r_y, r_sign, valid = prepare_batch(pubkeys, msgs, sigs)
+        if not valid.any():
+            return [False] * n
+        b = _bucket_size(n, self._pad_multiple())
+        pad = b - n
+        if pad:
+            neg_a = np.concatenate([neg_a, np.tile(neg_a[-1:], (pad, 1, 1))])
+            h_bits = np.concatenate([h_bits, np.zeros((pad, 256), dtype=np.int64)])
+            s_bits = np.concatenate([s_bits, np.zeros((pad, 256), dtype=np.int64)])
+            r_y = np.concatenate([r_y, np.zeros((pad, _N_LIMBS), dtype=np.int64)])
+            r_sign = np.concatenate([r_sign, np.zeros(pad, dtype=np.int64)])
+        ok = np.asarray(self._jitted()(neg_a, h_bits, s_bits, r_y, r_sign))[:n]
+        return list(np.logical_and(ok, valid))
+
+    def install(self) -> "BatchVerifier":
+        """Become the process-wide batch-verify hook used by
+        ValidatorSet.verify_commit* and friends."""
+        batch_hook.set_verifier(self.verify)
+        return self
+
+
+class PubkeyTable:
+    """HBM-resident decompressed validator pubkey table, keyed by validator
+    index — commits verify by gathering rows on-device (the BASELINE.json
+    north star).  Rebuilt only on validator-set changes."""
+
+    def __init__(self, pubkeys: Sequence[bytes], verifier: Optional[BatchVerifier] = None):
+        import jax.numpy as jnp
+
+        self.verifier = verifier or BatchVerifier()
+        n = len(pubkeys)
+        rows = np.zeros((max(n, 1), 4, _N_LIMBS), dtype=np.int64)
+        rows[:, 1, :1] = 1
+        rows[:, 2, :1] = 1
+        self.row_valid = np.zeros(max(n, 1), dtype=bool)
+        self.pubkeys = [bytes(pk) for pk in pubkeys]
+        for i, pk in enumerate(pubkeys):
+            limbs = _neg_a_limbs(bytes(pk))
+            if limbs is not None:
+                rows[i] = limbs
+                self.row_valid[i] = True
+        self.neg_a_rows = jnp.asarray(rows)  # device-resident
+
+    def __len__(self) -> int:
+        return len(self.pubkeys)
+
+    def verify_indexed(
+        self, idxs: Sequence[int], msgs: Sequence[bytes], sigs: Sequence[bytes]
+    ) -> List[bool]:
+        """Verify msgs[i]/sigs[i] against table row idxs[i]."""
+        import jax.numpy as jnp
+
+        n = len(sigs)
+        if n == 0:
+            return []
+        idx_arr = np.asarray(idxs, dtype=np.int64)
+        # Host prep for everything except pubkey limbs (gathered on device).
+        h_be = np.zeros((n, 32), dtype=np.uint8)
+        s_be = np.zeros((n, 32), dtype=np.uint8)
+        r_le = np.zeros((n, 32), dtype=np.uint8)
+        valid = np.zeros(n, dtype=bool)
+        for i, (idx, msg, sig) in enumerate(zip(idx_arr, msgs, sigs)):
+            if len(sig) != 64 or idx < 0 or idx >= len(self.pubkeys):
+                continue
+            if not self.row_valid[idx] or not em.sc_minimal(sig[32:]):
+                continue
+            h = em.compute_hram(sig[:32], self.pubkeys[idx], msg)
+            h_be[i] = np.frombuffer(h.to_bytes(32, "big"), dtype=np.uint8)
+            s = int.from_bytes(sig[32:], "little")
+            s_be[i] = np.frombuffer(s.to_bytes(32, "big"), dtype=np.uint8)
+            r_le[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+            valid[i] = True
+        if not valid.any():
+            return [False] * n
+
+        r_y, r_sign = _r_limbs_and_sign(r_le)
+        h_bits, s_bits = _msb_bits(h_be), _msb_bits(s_be)
+        b = _bucket_size(n, self.verifier._pad_multiple())
+        pad = b - n
+        if pad:
+            idx_arr = np.concatenate([idx_arr, np.zeros(pad, dtype=np.int64)])
+            h_bits = np.concatenate([h_bits, np.zeros((pad, 256), dtype=np.int64)])
+            s_bits = np.concatenate([s_bits, np.zeros((pad, 256), dtype=np.int64)])
+            r_y = np.concatenate([r_y, np.zeros((pad, _N_LIMBS), dtype=np.int64)])
+            r_sign = np.concatenate([r_sign, np.zeros(pad, dtype=np.int64)])
+        idx_arr = np.clip(idx_arr, 0, len(self.pubkeys) - 1)
+        neg_a = jnp.take(self.neg_a_rows, jnp.asarray(idx_arr), axis=0)
+        ok = np.asarray(self.verifier._jitted()(neg_a, h_bits, s_bits, r_y, r_sign))[:n]
+        return list(np.logical_and(ok, valid))
+
+
+# ---------------------------------------------------------------------------
+# async batcher — trickling votes coalesce into TPU batches
+# ---------------------------------------------------------------------------
+
+
+class AsyncBatchVerifier(Service):
+    """Deadline-flushed batcher (SURVEY.md §7 inversion #1).
+
+    Callers enqueue single (pubkey, msg, sig) checks and await a future;
+    a flusher drains the queue every `flush_interval` seconds (or
+    immediately at `max_batch`) into one BatchVerifier call.  Consensus
+    vote-add latency stays ~the flush interval while throughput scales with
+    batch size — the latency/batching tension called out in SURVEY.md §7.
+    """
+
+    def __init__(
+        self,
+        verifier: Optional[BatchVerifier] = None,
+        max_batch: int = 4096,
+        flush_interval: float = 0.002,
+    ):
+        super().__init__("batch-verifier")
+        self.verifier = verifier or BatchVerifier()
+        self.max_batch = max_batch
+        self.flush_interval = flush_interval
+        self._pending: List[Tuple[bytes, bytes, bytes, asyncio.Future]] = []
+        self._wake: Optional[asyncio.Event] = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def on_start(self) -> None:
+        self._wake = asyncio.Event()
+        self._task = asyncio.create_task(self._flush_loop())
+
+    async def on_stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        for _, _, _, fut in self._pending:
+            if not fut.done():
+                fut.cancel()
+        self._pending.clear()
+
+    def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> "asyncio.Future[bool]":
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending.append((pubkey, msg, sig, fut))
+        if len(self._pending) >= self.max_batch and self._wake:
+            self._wake.set()
+        return fut
+
+    async def _flush_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout=self.flush_interval)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            if not self._pending:
+                continue
+            batch, self._pending = self._pending, []
+            pubkeys = [b[0] for b in batch]
+            msgs = [b[1] for b in batch]
+            sigs = [b[2] for b in batch]
+            # The jitted call blocks this thread; consensus is itself awaiting
+            # these futures, so running inline keeps ordering deterministic.
+            results = self.verifier.verify(pubkeys, msgs, sigs)
+            for (_, _, _, fut), ok in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(bool(ok))

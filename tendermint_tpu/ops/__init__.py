@@ -1,0 +1,27 @@
+"""TPU compute kernels (JAX/XLA; Pallas where profiling demands).
+
+All kernels assume int64 is enabled — field arithmetic accumulates 17-bit
+limb products in int64 lanes.  Importing this package flips the JAX x64
+switch process-wide, which is deliberate: the framework owns the process.
+"""
+
+import os
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+# Persistent compile cache: the 256-iteration curve kernels are expensive to
+# compile (especially on the single-core CPU test host); cache survives
+# across processes so test/bench reruns skip recompilation.
+_cache_dir = os.environ.get("TM_TPU_JAX_CACHE", "/root/repo/.jax_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:  # older jax without the option — compile cache is best-effort
+    pass
+
+from . import fe  # noqa: E402
+from . import ed25519 as ed25519_kernel  # noqa: E402
+
+__all__ = ["fe", "ed25519_kernel"]

@@ -1,0 +1,6 @@
+"""Consensus state: the data needed to validate and execute new blocks."""
+
+from .state import State, make_genesis_state
+from .store import StateStore
+
+__all__ = ["State", "StateStore", "make_genesis_state"]

@@ -1,0 +1,150 @@
+"""State persistence: per-height validator sets, consensus params, ABCI
+responses.
+
+Reference parity: state/store.go (SaveState:97, LoadState:71,
+LoadValidators:295 with the "last height changed" pointer scheme,
+SaveABCIResponses:276, PruneStates).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..encoding import codec
+from ..libs.kvstore import KVStore
+from ..types import ConsensusParams, GenesisDoc, ValidatorSet
+from .state import State, make_genesis_state
+
+_K_STATE = b"stateKey"
+
+
+def _k_validators(height: int) -> bytes:
+    return b"validatorsKey:%d" % height
+
+
+def _k_params(height: int) -> bytes:
+    return b"consensusParamsKey:%d" % height
+
+
+def _k_abci_responses(height: int) -> bytes:
+    return b"abciResponsesKey:%d" % height
+
+
+class StateStore:
+    def __init__(self, db: KVStore):
+        self.db = db
+
+    # -- whole state -------------------------------------------------------
+    def save(self, state: State) -> None:
+        """SaveState (state/store.go:97): persists state + the validator set
+        / params that become active at the *next* height, using the
+        pointer-to-last-changed scheme so a 10k-validator set isn't
+        rewritten every block."""
+        next_height = state.last_block_height + 1
+        if next_height == 1:
+            # genesis bootstrap: heights 1 and 2 both known at this point
+            self._save_validators(next_height, next_height, state.validators)
+        self._save_validators(
+            next_height + 1, state.last_height_validators_changed, state.next_validators
+        )
+        self._save_params(
+            next_height, state.last_height_consensus_params_changed, state.consensus_params
+        )
+        self.db.set(_K_STATE, state.bytes())
+
+    def load(self) -> Optional[State]:
+        raw = self.db.get(_K_STATE)
+        if raw is None:
+            return None
+        return codec.loads(raw)
+
+    def load_from_db_or_genesis(self, gen_doc: GenesisDoc) -> State:
+        """state/store.go:56 LoadStateFromDBOrGenesisDoc."""
+        state = self.load()
+        if state is None or state.is_empty():
+            state = make_genesis_state(gen_doc)
+        return state
+
+    # -- historical validator sets ----------------------------------------
+    def _save_validators(self, height: int, last_changed: int, vals: ValidatorSet) -> None:
+        if height == last_changed:
+            payload = {"last_changed": last_changed, "validators": vals.to_dict()}
+        else:
+            # pointer record only — the full set lives at last_changed
+            payload = {"last_changed": last_changed, "validators": None}
+        self.db.set(_k_validators(height), codec.dumps(payload))
+
+    def load_validators(self, height: int) -> Optional[ValidatorSet]:
+        """LoadValidators (state/store.go:295): follow the pointer, then
+        fast-forward proposer priority by the height delta."""
+        d = self._load_validators_info(height)
+        if d is None:
+            return None
+        if d["validators"] is None:
+            last_changed = d["last_changed"]
+            d2 = self._load_validators_info(last_changed)
+            if d2 is None or d2["validators"] is None:
+                return None
+            vals = ValidatorSet.from_dict(d2["validators"])
+            if height > last_changed:
+                vals.increment_proposer_priority(height - last_changed)
+            return vals
+        return ValidatorSet.from_dict(d["validators"])
+
+    def _load_validators_info(self, height: int) -> Optional[dict]:
+        raw = self.db.get(_k_validators(height))
+        return codec.loads(raw) if raw else None
+
+    # -- historical consensus params --------------------------------------
+    def _save_params(self, height: int, last_changed: int, params: ConsensusParams) -> None:
+        if height == last_changed:
+            payload = {"last_changed": last_changed, "params": params.to_dict()}
+        else:
+            payload = {"last_changed": last_changed, "params": None}
+        self.db.set(_k_params(height), codec.dumps(payload))
+
+    def load_consensus_params(self, height: int) -> Optional[ConsensusParams]:
+        raw = self.db.get(_k_params(height))
+        if raw is None:
+            return None
+        d = codec.loads(raw)
+        if d["params"] is None:
+            raw2 = self.db.get(_k_params(d["last_changed"]))
+            if raw2 is None:
+                return None
+            d2 = codec.loads(raw2)
+            if d2["params"] is None:
+                return None
+            return ConsensusParams.from_dict(d2["params"])
+        return ConsensusParams.from_dict(d["params"])
+
+    # -- ABCI responses (for replay + RPC block_results) -------------------
+    def save_abci_responses(self, height: int, responses: dict) -> None:
+        """state/store.go:276 — responses = {"deliver_txs": [...],
+        "begin_block": {...}, "end_block": {...}} as plain dicts."""
+        self.db.set(_k_abci_responses(height), codec.dumps(responses))
+
+    def load_abci_responses(self, height: int) -> Optional[dict]:
+        raw = self.db.get(_k_abci_responses(height))
+        return codec.loads(raw) if raw else None
+
+    # -- pruning -----------------------------------------------------------
+    def prune_states(self, retain_height: int) -> None:
+        """Drop per-height records below retain_height, keeping records that
+        later pointer entries still reference."""
+        val_referenced = set()
+        info = self._load_validators_info(retain_height)
+        if info is not None:
+            val_referenced.add(info["last_changed"])
+        params_referenced = set()
+        raw = self.db.get(_k_params(retain_height))
+        if raw is not None:
+            params_referenced.add(codec.loads(raw)["last_changed"])
+        deletes = []
+        for h in range(1, retain_height):
+            if h not in val_referenced:
+                deletes.append(_k_validators(h))
+            if h not in params_referenced:
+                deletes.append(_k_params(h))
+            deletes.append(_k_abci_responses(h))
+        self.db.write_batch([], deletes)

@@ -1,0 +1,5 @@
+"""Persistent stores: blocks (parts + commits) and consensus state."""
+
+from .block_store import BlockMeta, BlockStore
+
+__all__ = ["BlockMeta", "BlockStore"]

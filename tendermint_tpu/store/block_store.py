@@ -1,0 +1,194 @@
+"""Block store: blocks persisted as merkle-proven parts + commits.
+
+Reference parity: store/store.go (BlockStore:33, SaveBlock:270,
+LoadBlock:78, LoadBlockPart, LoadBlockMeta, LoadBlockCommit,
+LoadSeenCommit, PruneBlocks:197).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from ..encoding import codec
+from ..libs.kvstore import KVStore
+from ..types import Block, BlockID, Commit, Header
+from ..types.part_set import Part, PartSet
+
+
+def _k_meta(height: int) -> bytes:
+    return b"H:%d" % height
+
+
+def _k_part(height: int, index: int) -> bytes:
+    return b"P:%d:%d" % (height, index)
+
+
+def _k_commit(height: int) -> bytes:
+    return b"C:%d" % height
+
+
+def _k_seen_commit(height: int) -> bytes:
+    return b"SC:%d" % height
+
+
+def _k_block_hash(h: bytes) -> bytes:
+    return b"BH:" + h
+
+
+_K_STATE = b"blockStore"
+
+
+@dataclass
+class BlockMeta:
+    """store/types.go BlockMeta: header + identity + sizes."""
+
+    block_id: BlockID
+    block_size: int
+    header: Header
+    num_txs: int
+
+    def to_dict(self) -> dict:
+        return {
+            "block_id": self.block_id.to_dict(),
+            "block_size": self.block_size,
+            "header": self.header.to_dict(),
+            "num_txs": self.num_txs,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BlockMeta":
+        return cls(
+            BlockID.from_dict(d["block_id"]), d["block_size"], Header.from_dict(d["header"]), d["num_txs"]
+        )
+
+
+codec.register("tm/BlockMeta")(BlockMeta)
+
+
+class BlockStore:
+    """Stores base..height contiguous blocks; prunes from the bottom on
+    app-driven retain height (store/store.go:197)."""
+
+    def __init__(self, db: KVStore):
+        self.db = db
+        self._mtx = threading.RLock()
+        state = db.get(_K_STATE)
+        if state is not None:
+            d = codec.loads(state)
+            self._base, self._height = d["base"], d["height"]
+        else:
+            self._base, self._height = 0, 0
+
+    def base(self) -> int:
+        with self._mtx:
+            return self._base
+
+    def height(self) -> int:
+        with self._mtx:
+            return self._height
+
+    def size(self) -> int:
+        with self._mtx:
+            return self._height - self._base + 1 if self._height else 0
+
+    def _save_state(self) -> None:
+        self.db.set(_K_STATE, codec.dumps({"base": self._base, "height": self._height}))
+
+    # -- saving ------------------------------------------------------------
+    def save_block(self, block: Block, part_set: PartSet, seen_commit: Commit) -> None:
+        """store/store.go:270 — meta + parts + canonical last-commit of the
+        previous block + our seen-commit for this block."""
+        if block is None:
+            raise ValueError("cannot save nil block")
+        height = block.height
+        with self._mtx:
+            expected = self._height + 1 if self._height else height
+            if height != expected:
+                raise ValueError(f"cannot save block at height {height}, expected {expected}")
+            if not part_set.is_complete():
+                raise ValueError("cannot save block with incomplete part set")
+
+            block_id = BlockID(block.hash(), part_set.header())
+            meta = BlockMeta(block_id, len(block.serialize()), block.header, len(block.txs))
+            sets = [
+                (_k_meta(height), codec.dumps(meta)),
+                (_k_block_hash(block.hash()), b"%d" % height),
+            ]
+            for i in range(part_set.total):
+                sets.append((_k_part(height, i), codec.dumps(part_set.get_part(i))))
+            if block.last_commit is not None:
+                sets.append((_k_commit(height - 1), codec.dumps(block.last_commit)))
+            sets.append((_k_seen_commit(height), codec.dumps(seen_commit)))
+            self.db.write_batch(sets)
+            if self._base == 0:
+                self._base = height
+            self._height = height
+            self._save_state()
+
+    # -- loading -----------------------------------------------------------
+    def load_block_meta(self, height: int) -> Optional[BlockMeta]:
+        raw = self.db.get(_k_meta(height))
+        return codec.loads(raw) if raw else None
+
+    def load_block_part(self, height: int, index: int) -> Optional[Part]:
+        raw = self.db.get(_k_part(height, index))
+        return codec.loads(raw) if raw else None
+
+    def load_block(self, height: int) -> Optional[Block]:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        chunks = []
+        for i in range(meta.block_id.parts_header.total):
+            part = self.load_block_part(height, i)
+            if part is None:
+                return None
+            chunks.append(part.bytes)
+        return Block.deserialize(b"".join(chunks))
+
+    def load_block_by_hash(self, h: bytes) -> Optional[Block]:
+        raw = self.db.get(_k_block_hash(h))
+        if raw is None:
+            return None
+        return self.load_block(int(raw))
+
+    def load_block_commit(self, height: int) -> Optional[Commit]:
+        """Canonical commit for height (from block height+1's LastCommit)."""
+        raw = self.db.get(_k_commit(height))
+        return codec.loads(raw) if raw else None
+
+    def load_seen_commit(self, height: int) -> Optional[Commit]:
+        """Locally-seen commit (may be for a later round than canonical)."""
+        raw = self.db.get(_k_seen_commit(height))
+        return codec.loads(raw) if raw else None
+
+    # -- pruning -----------------------------------------------------------
+    def prune_blocks(self, retain_height: int) -> int:
+        """Remove blocks below retain_height; returns count pruned
+        (store/store.go:197)."""
+        with self._mtx:
+            if retain_height <= 0:
+                raise ValueError(f"height must be greater than 0: {retain_height}")
+            if retain_height > self._height:
+                raise ValueError(
+                    f"cannot prune beyond the latest height {self._height}: {retain_height}"
+                )
+            pruned = 0
+            deletes = []
+            for h in range(self._base, min(retain_height, self._height)):
+                meta = self.load_block_meta(h)
+                if meta is None:
+                    continue
+                deletes.append(_k_meta(h))
+                deletes.append(_k_block_hash(meta.block_id.hash))
+                deletes.append(_k_commit(h))
+                deletes.append(_k_seen_commit(h))
+                for i in range(meta.block_id.parts_header.total):
+                    deletes.append(_k_part(h, i))
+                pruned += 1
+            self.db.write_batch([], deletes)
+            self._base = max(self._base, retain_height)
+            self._save_state()
+            return pruned

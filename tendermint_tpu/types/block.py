@@ -496,9 +496,10 @@ class Block:
             if self.last_commit is None:
                 raise ValueError("nil LastCommit")
             self.last_commit.validate_basic()
+        # compare received header fields against recomputed values — no
+        # fill_header() here: an omitted hash must fail, and validation must
+        # not mutate a block whose bytes peers signed over
         validate_hash(h.last_commit_hash)
-        self.fill_header()
-        h = self.header
         expected_lc = self.last_commit.hash() if self.last_commit else merkle.hash_from_byte_slices([])
         if h.last_commit_hash != expected_lc:
             raise ValueError("wrong Header.LastCommitHash")

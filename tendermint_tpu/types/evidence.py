@@ -12,8 +12,7 @@ from typing import List, Optional
 from ..crypto import merkle, tmhash
 from ..crypto.keys import PubKey, pubkey_from_dict
 from ..encoding import codec
-
-MAX_EVIDENCE_BYTES = 484
+from .params import MAX_EVIDENCE_BYTES  # noqa: F401  (single source of truth)
 
 
 class Evidence(ABC):

@@ -147,14 +147,6 @@ class ConsensusParams:
         )
 
 
-def max_evidence_per_block(block_max_bytes: int) -> tuple[int, int]:
-    """(max count, max total bytes) — evidence capped at 1/10 of block size
-    (types/evidence.go:92 MaxEvidencePerBlock)."""
-    max_bytes = block_max_bytes // 10
-    max_num = max_bytes // MAX_EVIDENCE_BYTES
-    return max_num, max_bytes
-
-
 MAX_EVIDENCE_BYTES = 484  # types/evidence.go:21
 MAX_VOTE_BYTES = 223  # types/vote.go:15
 MAX_HEADER_BYTES = 632  # types/block.go:23
@@ -162,3 +154,11 @@ MAX_OVERHEAD_FOR_BLOCK = 11  # types/block.go:34
 MAX_CHAIN_ID_LEN = 50  # types/genesis.go:21
 MAX_SIGNATURE_SIZE = 96  # fits ed25519(64) and future aggregated sigs
 MAX_VOTES_COUNT = 10000  # types/vote_set.go:18
+
+
+def max_evidence_per_block(block_max_bytes: int) -> tuple[int, int]:
+    """(max count, max total bytes) — evidence capped at 1/10 of block size
+    (types/evidence.go:92 MaxEvidencePerBlock)."""
+    max_bytes = block_max_bytes // 10
+    max_num = max_bytes // MAX_EVIDENCE_BYTES
+    return max_num, max_bytes

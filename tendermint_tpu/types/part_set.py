@@ -88,7 +88,7 @@ class PartSet:
     def add_part(self, part: Part) -> bool:
         """types/part_set.go:186.  False for duplicates; raises on invalid
         index or proof."""
-        if part.index >= self.total:
+        if part.index < 0 or part.index >= self.total:
             raise PartSetError("unexpected part index")
         if self.parts[part.index] is not None:
             return False

@@ -228,7 +228,7 @@ class VoteSet:
         return self.signed_msg_type == PRECOMMIT_TYPE and self.maj23 is not None
 
     def has_two_thirds_any(self) -> bool:
-        return self.sum > self.val_set.total_voting_power() * 2 / 3
+        return self.sum > self.val_set.total_voting_power() * 2 // 3
 
     def has_all(self) -> bool:
         return self.sum == self.val_set.total_voting_power()

@@ -449,12 +449,25 @@ class TestBlock:
 
     def test_block_validate_basic(self):
         b, _, _ = make_test_block(height=2)
+        b.fill_header()
         b.validate_basic()
 
     def test_block_validate_rejects_bad(self):
         b, _, _ = make_test_block(height=2)
+        b.fill_header()
         b.last_commit = None
         with pytest.raises(ValueError, match="LastCommit"):
+            b.validate_basic()
+
+    def test_block_validate_rejects_unfilled_hashes(self):
+        # a received block with an omitted data_hash must NOT validate —
+        # validation cannot fill fields in on the receiver's behalf
+        import dataclasses
+
+        b, _, _ = make_test_block(height=2)
+        b.fill_header()
+        b.header = dataclasses.replace(b.header, data_hash=b"")
+        with pytest.raises(ValueError, match="DataHash"):
             b.validate_basic()
 
     def test_block_serialization_roundtrip(self):
