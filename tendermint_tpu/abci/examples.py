@@ -1,0 +1,201 @@
+"""Example ABCI apps: kvstore and counter — the framework's test fixtures.
+
+Reference parity: abci/example/kvstore/kvstore.go (NewApplication:71,
+tx format "key=value"), persistent_kvstore.go (validator-update txs
+"val:<base64 pubkey>!<power>", InitChain, retain-height), and
+abci/example/counter/counter.go (serial-nonce app).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import struct
+from typing import Dict, List, Optional
+
+from ..libs.kvstore import KVStore, MemDB
+from . import types as t
+
+VALIDATOR_TX_PREFIX = b"val:"
+
+
+class KVStoreApplication(t.Application):
+    """Merkle-less KV app.  Tx "key=value" sets key; bare "v" sets v=v.
+    "val:<b64 pubkey>!<power>" updates the validator set (the mechanism the
+    validator-change tests drive).  app_hash commits to (size, update
+    count) deterministically."""
+
+    def __init__(self, db: Optional[KVStore] = None, retain_blocks: int = 0):
+        self.db = db or MemDB()
+        self.retain_blocks = retain_blocks
+        self.height = 0
+        self.app_hash = b""
+        self.tx_count = 0
+        self.validators: Dict[bytes, int] = {}  # pubkey -> power
+        self._pending_updates: List[t.ValidatorUpdate] = []
+        self._load_state()
+
+    # -- state persistence -------------------------------------------------
+    def _load_state(self) -> None:
+        raw = self.db.get(b"__state__")
+        if raw:
+            height, tx_count, hash_len = struct.unpack("<QQB", raw[:17])
+            self.height, self.tx_count = height, tx_count
+            self.app_hash = raw[17 : 17 + hash_len]
+        for k, v in self.db.iterate_prefix(b"__val__"):
+            self.validators[k[len(b"__val__"):]] = struct.unpack("<q", v)[0]
+
+    def _save_state(self) -> None:
+        self.db.set(
+            b"__state__",
+            struct.pack("<QQB", self.height, self.tx_count, len(self.app_hash)) + self.app_hash,
+        )
+
+    # -- ABCI --------------------------------------------------------------
+    def info(self, req: t.RequestInfo) -> t.ResponseInfo:
+        return t.ResponseInfo(
+            data="{\"size\":%d}" % self.tx_count,
+            version="0.1.0",
+            app_version=1,
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash,
+        )
+
+    def init_chain(self, req: t.RequestInitChain) -> t.ResponseInitChain:
+        for vu in req.validators:
+            self._set_validator(vu)
+        return t.ResponseInitChain()
+
+    def begin_block(self, req: t.RequestBeginBlock) -> t.ResponseBeginBlock:
+        self._pending_updates = []
+        return t.ResponseBeginBlock()
+
+    def _is_validator_tx(self, tx: bytes) -> bool:
+        return tx.startswith(VALIDATOR_TX_PREFIX)
+
+    def _parse_validator_tx(self, tx: bytes) -> Optional[t.ValidatorUpdate]:
+        try:
+            body = tx[len(VALIDATOR_TX_PREFIX):]
+            pk_b64, power = body.split(b"!", 1)
+            return t.ValidatorUpdate(
+                pub_key_type="ed25519", pub_key=base64.b64decode(pk_b64), power=int(power)
+            )
+        except Exception:
+            return None
+
+    def check_tx(self, req: t.RequestCheckTx) -> t.ResponseCheckTx:
+        if self._is_validator_tx(req.tx) and self._parse_validator_tx(req.tx) is None:
+            return t.ResponseCheckTx(code=1, log="invalid validator tx")
+        return t.ResponseCheckTx(code=t.CODE_TYPE_OK, gas_wanted=1)
+
+    def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
+        if self._is_validator_tx(req.tx):
+            vu = self._parse_validator_tx(req.tx)
+            if vu is None:
+                return t.ResponseDeliverTx(code=1, log="invalid validator tx")
+            self._set_validator(vu)
+            self._pending_updates.append(vu)
+            return t.ResponseDeliverTx(code=t.CODE_TYPE_OK)
+        if b"=" in req.tx:
+            key, value = req.tx.split(b"=", 1)
+        else:
+            key, value = req.tx, req.tx
+        self.db.set(b"kv:" + key, value)
+        self.tx_count += 1
+        events = [
+            t.Event(
+                type="app",
+                attributes=[
+                    {"key": b"creator", "value": b"tendermint_tpu"},
+                    {"key": b"key", "value": key},
+                ],
+            )
+        ]
+        return t.ResponseDeliverTx(code=t.CODE_TYPE_OK, events=events)
+
+    def _set_validator(self, vu: t.ValidatorUpdate) -> None:
+        if vu.power == 0:
+            self.validators.pop(vu.pub_key, None)
+            self.db.delete(b"__val__" + vu.pub_key)
+        else:
+            self.validators[vu.pub_key] = vu.power
+            self.db.set(b"__val__" + vu.pub_key, struct.pack("<q", vu.power))
+
+    def end_block(self, req: t.RequestEndBlock) -> t.ResponseEndBlock:
+        return t.ResponseEndBlock(validator_updates=list(self._pending_updates))
+
+    def commit(self, req: t.RequestCommit = None) -> t.ResponseCommit:
+        self.height += 1
+        self.app_hash = hashlib.sha256(
+            struct.pack("<QQ", self.tx_count, self.height)
+        ).digest()
+        self._save_state()
+        retain = 0
+        if self.retain_blocks > 0 and self.height >= self.retain_blocks:
+            retain = self.height - self.retain_blocks + 1
+        return t.ResponseCommit(data=self.app_hash, retain_height=retain)
+
+    def query(self, req: t.RequestQuery) -> t.ResponseQuery:
+        if req.path == "/val":
+            power = self.validators.get(req.data, 0)
+            return t.ResponseQuery(code=t.CODE_TYPE_OK, value=struct.pack("<q", power))
+        value = self.db.get(b"kv:" + req.data)
+        if value is None:
+            return t.ResponseQuery(code=t.CODE_TYPE_OK, key=req.data, log="does not exist")
+        return t.ResponseQuery(code=t.CODE_TYPE_OK, key=req.data, value=value, log="exists", height=self.height)
+
+
+class CounterApplication(t.Application):
+    """Serial-nonce app (abci/example/counter): txs must be the big-endian
+    encoding of the next count when serial mode is on."""
+
+    def __init__(self, serial: bool = True):
+        self.serial = serial
+        self.tx_count = 0
+        self.check_count = 0
+
+    def info(self, req: t.RequestInfo) -> t.ResponseInfo:
+        return t.ResponseInfo(data=f"{{\"hashes\":0,\"txs\":{self.tx_count}}}")
+
+    def set_option(self, req: t.RequestSetOption) -> t.ResponseSetOption:
+        if req.key == "serial":
+            self.serial = req.value == "on"
+        return t.ResponseSetOption()
+
+    def _tx_value(self, tx: bytes) -> int:
+        if len(tx) > 8:
+            return -1
+        return int.from_bytes(tx, "big")
+
+    def check_tx(self, req: t.RequestCheckTx) -> t.ResponseCheckTx:
+        if self.serial:
+            v = self._tx_value(req.tx)
+            if v < self.check_count:
+                return t.ResponseCheckTx(
+                    code=2, log=f"invalid nonce: got {v}, expected >= {self.check_count}"
+                )
+        self.check_count += 1
+        return t.ResponseCheckTx(code=t.CODE_TYPE_OK)
+
+    def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
+        if self.serial:
+            v = self._tx_value(req.tx)
+            if v != self.tx_count:
+                return t.ResponseDeliverTx(
+                    code=2, log=f"invalid nonce: got {v}, expected {self.tx_count}"
+                )
+        self.tx_count += 1
+        return t.ResponseDeliverTx(code=t.CODE_TYPE_OK)
+
+    def commit(self, req: t.RequestCommit = None) -> t.ResponseCommit:
+        self.check_count = self.tx_count
+        if self.tx_count == 0:
+            return t.ResponseCommit(data=b"")
+        return t.ResponseCommit(data=self.tx_count.to_bytes(8, "big"))
+
+    def query(self, req: t.RequestQuery) -> t.ResponseQuery:
+        if req.path == "tx":
+            return t.ResponseQuery(value=str(self.tx_count).encode())
+        if req.path == "hash":
+            return t.ResponseQuery(value=str(self.tx_count).encode())
+        return t.ResponseQuery(log=f"invalid query path: {req.path}")

@@ -1,0 +1,293 @@
+"""Node configuration.
+
+Reference parity: config/config.go (Config:60 aggregating Base/RPC/P2P/
+Mempool/FastSync/Consensus/TxIndex/Instrumentation; consensus timeouts with
+per-round linear growth :815-833; TestConfig :792 with millisecond
+timeouts; ValidateBasic :855) and config/toml.go (TOML file mapping).
+Times are seconds (float) here; per-round growth matches base + delta*round.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, replace
+from typing import List, Optional
+
+
+@dataclass
+class BaseConfig:
+    chain_id: str = ""
+    moniker: str = "node"
+    fast_sync: bool = True
+    proxy_app: str = "kvstore"
+    abci: str = "socket"
+    db_backend: str = "sqlite"
+    log_level: str = "info"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    priv_validator_laddr: str = ""
+    node_key_file: str = "config/node_key.json"
+    filter_peers: bool = False
+    prof_laddr: str = ""
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    grpc_laddr: str = ""
+    unsafe: bool = False
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit: float = 10.0
+    max_body_bytes: int = 1000000
+    max_header_bytes: int = 1 << 20
+    cors_allowed_origins: List[str] = field(default_factory=list)
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    upnp: bool = False
+    addr_book_file: str = "config/addrbook.json"
+    addr_book_strict: bool = True
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    unconditional_peer_ids: str = ""
+    persistent_peers_max_dial_period: float = 0.0
+    flush_throttle_timeout: float = 0.1
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5120000
+    recv_rate: int = 5120000
+    pex: bool = True
+    seed_mode: bool = False
+    private_peer_ids: str = ""
+    allow_duplicate_ip: bool = False
+    handshake_timeout: float = 20.0
+    dial_timeout: float = 3.0
+    test_fuzz: bool = False
+
+
+@dataclass
+class MempoolConfig:
+    recheck: bool = True
+    broadcast: bool = True
+    wal_dir: str = ""
+    size: int = 5000
+    max_txs_bytes: int = 1073741824
+    cache_size: int = 10000
+    max_tx_bytes: int = 1048576
+    keep_invalid_txs_in_cache: bool = False
+
+    def as_dict(self) -> dict:
+        return {
+            "recheck": self.recheck,
+            "size": self.size,
+            "max_txs_bytes": self.max_txs_bytes,
+            "cache_size": self.cache_size,
+            "max_tx_bytes": self.max_tx_bytes,
+            "keep_invalid_txs_in_cache": self.keep_invalid_txs_in_cache,
+        }
+
+
+@dataclass
+class FastSyncConfig:
+    version: str = "v0"
+
+
+@dataclass
+class ConsensusConfig:
+    wal_file: str = "data/cs.wal/wal"
+    # reference defaults (config/config.go:774-790)
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+    peer_gossip_sleep_duration: float = 0.1
+    peer_query_maj23_sleep_duration: float = 2.0
+
+    def propose(self, round_: int) -> float:
+        """config.go:815 — base + delta·round."""
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote(self, round_: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit(self, round_: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+    def commit(self, t: float) -> float:
+        """Start-time of the next height = commit time + timeout_commit."""
+        return t + self.timeout_commit
+
+    def wait_for_txs(self) -> bool:
+        return not self.create_empty_blocks or self.create_empty_blocks_interval > 0
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: str = "kv"  # kv | null
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    max_open_connections: int = 3
+    namespace: str = "tendermint"
+
+
+@dataclass
+class Config:
+    home: str = "~/.tendermint_tpu"
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    fast_sync: FastSyncConfig = field(default_factory=FastSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+
+    # -- paths -------------------------------------------------------------
+    def _join(self, p: str) -> str:
+        return p if os.path.isabs(p) else os.path.join(os.path.expanduser(self.home), p)
+
+    def genesis_file(self) -> str:
+        return self._join(self.base.genesis_file)
+
+    def priv_validator_key_file(self) -> str:
+        return self._join(self.base.priv_validator_key_file)
+
+    def priv_validator_state_file(self) -> str:
+        return self._join(self.base.priv_validator_state_file)
+
+    def node_key_file(self) -> str:
+        return self._join(self.base.node_key_file)
+
+    def wal_file(self) -> str:
+        return self._join(self.consensus.wal_file)
+
+    def addr_book_file(self) -> str:
+        return self._join(self.p2p.addr_book_file)
+
+    def db_dir(self) -> str:
+        return self._join("data")
+
+    def ensure_dirs(self) -> None:
+        for sub in ("config", "data"):
+            os.makedirs(self._join(sub), exist_ok=True)
+
+    def validate_basic(self) -> None:
+        """config.go:855."""
+        if self.base.db_backend not in ("sqlite", "memdb"):
+            raise ValueError(f"unknown db_backend {self.base.db_backend!r}")
+        for name, v in (
+            ("timeout_propose", self.consensus.timeout_propose),
+            ("timeout_prevote", self.consensus.timeout_prevote),
+            ("timeout_precommit", self.consensus.timeout_precommit),
+            ("timeout_commit", self.consensus.timeout_commit),
+        ):
+            if v < 0:
+                raise ValueError(f"consensus.{name} can't be negative")
+        if self.mempool.size < 0:
+            raise ValueError("mempool.size can't be negative")
+        if self.rpc.max_open_connections < 0:
+            raise ValueError("rpc.max_open_connections can't be negative")
+        if self.fast_sync.version not in ("v0", "v2"):
+            raise ValueError(f"unknown fastsync version {self.fast_sync.version!r}")
+
+
+def default_config(home: str = "~/.tendermint_tpu") -> Config:
+    return Config(home=home)
+
+
+def test_config(home: str) -> Config:
+    """Millisecond timeouts for in-proc tests (config.go:792 TestConfig)."""
+    cfg = Config(home=home)
+    cfg.consensus = ConsensusConfig(
+        wal_file="data/cs.wal/wal",
+        timeout_propose=0.1,
+        timeout_propose_delta=0.002,
+        timeout_prevote=0.02,
+        timeout_prevote_delta=0.002,
+        timeout_precommit=0.02,
+        timeout_precommit_delta=0.002,
+        timeout_commit=0.02,
+        skip_timeout_commit=True,
+        peer_gossip_sleep_duration=0.005,
+        peer_query_maj23_sleep_duration=0.25,
+    )
+    cfg.base.fast_sync = False
+    return cfg
+
+
+# -- TOML round-trip (config/toml.go) ---------------------------------------
+
+
+def save_config(cfg: Config, path: str) -> None:
+    """Write the config as TOML (sections mirror the reference file)."""
+    import dataclasses
+
+    lines = ["# tendermint_tpu config\n"]
+    sections = {
+        "": cfg.base,
+        "rpc": cfg.rpc,
+        "p2p": cfg.p2p,
+        "mempool": cfg.mempool,
+        "fastsync": cfg.fast_sync,
+        "consensus": cfg.consensus,
+        "tx_index": cfg.tx_index,
+        "instrumentation": cfg.instrumentation,
+    }
+    for name, section in sections.items():
+        if name:
+            lines.append(f"\n[{name}]\n")
+        for f in dataclasses.fields(section):
+            v = getattr(section, f.name)
+            if isinstance(v, bool):
+                sv = "true" if v else "false"
+            elif isinstance(v, (int, float)):
+                sv = str(v)
+            elif isinstance(v, list):
+                sv = "[" + ", ".join(f'"{x}"' for x in v) + "]"
+            else:
+                sv = f'"{v}"'
+            lines.append(f"{f.name} = {sv}\n")
+    with open(path, "w") as fh:
+        fh.writelines(lines)
+
+
+def load_config(path: str, home: Optional[str] = None) -> Config:
+    import dataclasses
+    import tomllib
+
+    with open(path, "rb") as fh:
+        data = tomllib.load(fh)
+    cfg = Config(home=home or os.path.dirname(os.path.dirname(path)))
+
+    def apply(section_obj, d: dict):
+        names = {f.name for f in dataclasses.fields(section_obj)}
+        for k, v in d.items():
+            if k in names and not isinstance(v, dict):
+                setattr(section_obj, k, v)
+
+    apply(cfg.base, {k: v for k, v in data.items() if not isinstance(v, dict)})
+    apply(cfg.rpc, data.get("rpc", {}))
+    apply(cfg.p2p, data.get("p2p", {}))
+    apply(cfg.mempool, data.get("mempool", {}))
+    apply(cfg.fast_sync, data.get("fastsync", {}))
+    apply(cfg.consensus, data.get("consensus", {}))
+    apply(cfg.tx_index, data.get("tx_index", {}))
+    apply(cfg.instrumentation, data.get("instrumentation", {}))
+    return cfg

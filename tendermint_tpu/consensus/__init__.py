@@ -1,0 +1,23 @@
+"""Consensus: the BFT state machine, WAL, timeout ticker, replay."""
+
+from .types import (
+    HeightVoteSet,
+    RoundState,
+    RoundStep,
+)
+from .ticker import TimeoutInfo, TimeoutTicker
+from .wal import WAL, NilWAL
+from .state import ConsensusState
+from .replay import Handshaker
+
+__all__ = [
+    "ConsensusState",
+    "Handshaker",
+    "HeightVoteSet",
+    "NilWAL",
+    "RoundState",
+    "RoundStep",
+    "TimeoutInfo",
+    "TimeoutTicker",
+    "WAL",
+]

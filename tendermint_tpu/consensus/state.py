@@ -1,0 +1,1028 @@
+"""The consensus state machine.
+
+Reference parity: consensus/state.go (State:75, receiveRoutine:602,
+handleMsg:678, handleTimeout:745, enterNewRound:815, enterPropose:895,
+defaultDecideProposal:968, enterPrevote:1063, enterPrevoteWait:1113,
+enterPrecommit:1158, enterPrecommitWait:1262, enterCommit:1288,
+tryFinalizeCommit:1352, finalizeCommit:1381, defaultSetProposal:1600,
+addProposalBlockPart:1636, tryAddVote:1706, addVote:1751, signVote:1922,
+signAddVote:1961, updateToState:505, reconstructLastCommit:487).
+
+Architecture: all mutation is serialized through ONE asyncio task reading a
+single queue (the reference's single-goroutine receiveRoutine — its core
+race-avoidance mechanism, SURVEY.md §5).  Timeouts are forwarded from the
+ticker into the same queue; every input is WAL-logged before processing
+(fsync for our own signed messages) so crash replay is deterministic.
+
+The `decide_proposal` / `do_prevote` / `set_proposal` methods are instance
+attributes precisely so byzantine tests can hijack them
+(consensus/state.go:124-126).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional, Tuple
+
+from ..libs.fail import fail_point
+from ..libs.log import get_logger
+from ..libs.service import Service
+from ..state.state import State as SMState
+from ..types import (
+    Block,
+    BlockID,
+    Commit,
+    ErrVoteConflictingVotes,
+    PartSetHeader,
+    Proposal,
+    Vote,
+    VoteSet,
+)
+from ..types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from ..types.part_set import Part, PartSet, PartSetError
+from ..types.params import BLOCK_PART_SIZE_BYTES
+from ..types.vote import VoteError
+from .ticker import TimeoutInfo, TimeoutTicker
+from .types import HeightVoteSet, RoundState, RoundStep
+from .wal import NilWAL
+
+
+class VoteHeightMismatchError(VoteError):
+    pass
+
+
+class InvalidProposalSignatureError(Exception):
+    pass
+
+
+class InvalidProposalPOLRoundError(Exception):
+    pass
+
+
+def _vote_to_wire(vote: Vote) -> dict:
+    return vote.to_dict()
+
+
+class ConsensusState(Service):
+    def __init__(
+        self,
+        config,  # ConsensusConfig
+        state: SMState,
+        block_exec,
+        block_store,
+        mempool,
+        evidence_pool=None,
+        event_bus=None,
+        options=None,
+    ):
+        super().__init__("consensus")
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
+        self.event_bus = event_bus
+        self.log = get_logger("consensus")
+
+        self.priv_validator = None
+        self.wal = NilWAL()
+        self.do_wal_catchup = True
+        self.replay_mode = False
+
+        # the round state
+        self.rs = RoundState()
+        self.sm_state: Optional[SMState] = None
+
+        self.timeout_ticker = TimeoutTicker()
+        self.msg_queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
+        self.n_steps = 0
+        self._receive_task: Optional[asyncio.Task] = None
+        self._ticker_pump: Optional[asyncio.Task] = None
+        self._txs_pump: Optional[asyncio.Task] = None
+        self._done = asyncio.Event()
+
+        # observers (reactor hooks; the reference's evsw synchronous events)
+        self.on_new_round_step = []  # callables(RoundState)
+        self.on_vote = []  # callables(Vote)
+        self.on_valid_block = []  # callables(RoundState)
+        self.on_proposal_heartbeat = []
+
+        # overridable behaviours for byzantine tests
+        self.decide_proposal = self.default_decide_proposal
+        self.do_prevote = self.default_do_prevote
+        self.set_proposal = self.default_set_proposal
+
+        self.update_to_state(state)
+        self.reconstruct_last_commit_if_needed(state)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def set_priv_validator(self, pv) -> None:
+        self.priv_validator = pv
+
+    def reconstruct_last_commit_if_needed(self, state: SMState) -> None:
+        """consensus/state.go:487 — rebuild LastCommit votes from the
+        stored SeenCommit."""
+        if state.last_block_height == 0:
+            return
+        seen_commit = self.block_store.load_seen_commit(state.last_block_height)
+        if seen_commit is None:
+            raise RuntimeError(
+                f"failed to reconstruct last commit: seen commit for height "
+                f"{state.last_block_height} not found"
+            )
+        last_precommits = commit_to_vote_set(state.chain_id, seen_commit, state.last_validators)
+        if not last_precommits.has_two_thirds_majority():
+            raise RuntimeError("failed to reconstruct last commit: does not have +2/3 maj")
+        self.rs.last_commit = last_precommits
+
+    async def on_start(self) -> None:
+        await self.timeout_ticker.start()
+        if self.do_wal_catchup and not isinstance(self.wal, NilWAL):
+            from .replay import catchup_replay
+
+            await catchup_replay(self, self.rs.height)
+        self._ticker_pump = self.spawn(self._pump_timeouts(), "ticker-pump")
+        if self.mempool.txs_available() is not None:
+            self._txs_pump = self.spawn(self._pump_txs_available(), "txs-pump")
+        self._receive_task = self.spawn(self._receive_routine(), "receive")
+        self.schedule_round0()
+
+    async def on_stop(self) -> None:
+        await self.timeout_ticker.stop()
+        self.wal.close()
+
+    async def wait_done(self) -> None:
+        await self._done.wait()
+
+    # ------------------------------------------------------------------
+    # inputs (reactor/public surface)
+    # ------------------------------------------------------------------
+    async def add_vote_input(self, vote: Vote, peer_id: str = "") -> None:
+        await self.msg_queue.put(
+            {"type": "vote", "vote": vote, "peer_id": peer_id}
+        )
+
+    async def set_proposal_input(self, proposal: Proposal, peer_id: str = "") -> None:
+        await self.msg_queue.put({"type": "proposal", "proposal": proposal, "peer_id": peer_id})
+
+    async def add_block_part_input(
+        self, height: int, round_: int, part: Part, peer_id: str = ""
+    ) -> None:
+        await self.msg_queue.put(
+            {"type": "block_part", "height": height, "round": round_, "part": part, "peer_id": peer_id}
+        )
+
+    async def set_proposal_and_block(
+        self, proposal: Proposal, block_parts: PartSet, peer_id: str = ""
+    ) -> None:
+        await self.set_proposal_input(proposal, peer_id)
+        for i in range(block_parts.total):
+            await self.add_block_part_input(proposal.height, proposal.round, block_parts.get_part(i), peer_id)
+
+    def _send_internal_nowait(self, mi: dict) -> None:
+        """sendInternalMessage (state.go:477): never drop our own msgs."""
+        try:
+            self.msg_queue.put_nowait(mi)
+        except asyncio.QueueFull:
+            asyncio.get_event_loop().create_task(self.msg_queue.put(mi))
+
+    # ------------------------------------------------------------------
+    # the serialized receive loop
+    # ------------------------------------------------------------------
+    async def _pump_timeouts(self) -> None:
+        while True:
+            ti = await self.timeout_ticker.chan().get()
+            await self.msg_queue.put({"type": "timeout", "ti": ti})
+
+    async def _pump_txs_available(self) -> None:
+        while True:
+            ev = self.mempool.txs_available()
+            await ev.wait()
+            ev.clear()
+            await self.msg_queue.put({"type": "txs_available"})
+
+    async def _receive_routine(self) -> None:
+        """state.go:602 — the single serialization point."""
+        try:
+            while True:
+                # Queue.get returns without yielding when non-empty; the loop
+                # is self-feeding (own votes/parts), so yield explicitly or
+                # every other task on the loop starves.
+                await asyncio.sleep(0)
+                mi = await self.msg_queue.get()
+                kind = mi["type"]
+                if kind == "timeout":
+                    ti: TimeoutInfo = mi["ti"]
+                    self.wal.write(
+                        {"type": "timeout", "height": ti.height, "round": ti.round,
+                         "step": ti.step, "duration": ti.duration}
+                    )
+                    await self._handle_timeout(ti)
+                elif kind == "txs_available":
+                    await self._handle_txs_available()
+                else:
+                    internal = not mi.get("peer_id")
+                    wal_rec = {"type": "msg", "peer_id": mi.get("peer_id", ""), "msg": _wire_msg(mi)}
+                    if internal:
+                        self.wal.write_sync(wal_rec)  # own msgs fsync (state.go:650)
+                        if kind == "vote":
+                            fail_point("own-vote-walled")
+                    else:
+                        self.wal.write(wal_rec)
+                    await self._handle_msg(mi)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # chain halt on consensus failure (state.go:617)
+            import traceback
+
+            self.log.error("CONSENSUS FAILURE!!!", err=repr(e))
+            traceback.print_exc()
+        finally:
+            self.wal.close()
+            self._done.set()
+
+    async def _handle_msg(self, mi: dict) -> None:
+        """state.go:678."""
+        kind, peer_id = mi["type"], mi.get("peer_id", "")
+        try:
+            if kind == "proposal":
+                await self.set_proposal(mi["proposal"])
+            elif kind == "block_part":
+                await self._add_proposal_block_part(mi["height"], mi["round"], mi["part"], peer_id)
+            elif kind == "vote":
+                await self._try_add_vote(mi["vote"], peer_id)
+        except ErrVoteConflictingVotes:
+            raise  # own double-sign — _try_add_vote re-raises only then; halt
+        except (VoteError, PartSetError, InvalidProposalSignatureError,
+                InvalidProposalPOLRoundError) as e:
+            self.log.debug("error with msg", kind=kind, peer=peer_id, err=str(e))
+
+    async def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """state.go:745 — timeouts must match current H/R/S."""
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or (
+            ti.round == rs.round and ti.step < rs.step
+        ):
+            return
+        if ti.step == RoundStep.NEW_HEIGHT:
+            await self.enter_new_round(ti.height, 0)
+        elif ti.step == RoundStep.NEW_ROUND:
+            await self.enter_propose(ti.height, 0)
+        elif ti.step == RoundStep.PROPOSE:
+            if self.event_bus:
+                await self.event_bus.publish_timeout_propose(rs.event_dict())
+            await self.enter_prevote(ti.height, ti.round)
+        elif ti.step == RoundStep.PREVOTE_WAIT:
+            if self.event_bus:
+                await self.event_bus.publish_timeout_wait(rs.event_dict())
+            await self.enter_precommit(ti.height, ti.round)
+        elif ti.step == RoundStep.PRECOMMIT_WAIT:
+            if self.event_bus:
+                await self.event_bus.publish_timeout_wait(rs.event_dict())
+            await self.enter_precommit(ti.height, ti.round)
+            await self.enter_new_round(ti.height, ti.round + 1)
+        else:
+            raise ValueError(f"invalid timeout step {ti.step}")
+
+    async def _handle_txs_available(self) -> None:
+        """state.go:787."""
+        if self.rs.round != 0:
+            return
+        if self.rs.step == RoundStep.NEW_HEIGHT:
+            if self._need_proof_block(self.rs.height):
+                return
+            timeout_commit = self.rs.start_time - time.monotonic() + 0.001
+            self._schedule_timeout(timeout_commit, self.rs.height, 0, RoundStep.NEW_ROUND)
+        elif self.rs.step == RoundStep.NEW_ROUND:
+            await self.enter_propose(self.rs.height, 0)
+
+    # ------------------------------------------------------------------
+    # state transitions
+    # ------------------------------------------------------------------
+    async def enter_new_round(self, height: int, round_: int) -> None:
+        """state.go:815."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step != RoundStep.NEW_HEIGHT
+        ):
+            return
+        self.log.debug("enterNewRound", height=height, round=round_)
+
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+
+        self._update_round_step(round_, RoundStep.NEW_ROUND)
+        rs.validators = validators
+        if round_ != 0:
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_ + 1)  # track next round for skipping
+        rs.triggered_timeout_precommit = False
+
+        if self.event_bus:
+            await self.event_bus.publish_new_round(height, round_, validators.get_proposer())
+
+        wait_for_txs = (
+            self.config.wait_for_txs() and round_ == 0 and not self._need_proof_block(height)
+        )
+        if wait_for_txs:
+            if self.config.create_empty_blocks_interval > 0:
+                self._schedule_timeout(
+                    self.config.create_empty_blocks_interval, height, round_, RoundStep.NEW_ROUND
+                )
+        else:
+            await self.enter_propose(height, round_)
+
+    def _need_proof_block(self, height: int) -> bool:
+        """state.go:877 — first height, or app hash changed last block."""
+        if height == 1:
+            return True
+        last_meta = self.block_store.load_block_meta(height - 1)
+        if last_meta is None:
+            raise RuntimeError(f"need_proof_block: no block meta for height {height - 1}")
+        return self.sm_state.app_hash != last_meta.header.app_hash
+
+    async def enter_propose(self, height: int, round_: int) -> None:
+        """state.go:895."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PROPOSE
+        ):
+            return
+        self.log.debug("enterPropose", height=height, round=round_)
+
+        try:
+            self._schedule_timeout(self.config.propose(round_), height, round_, RoundStep.PROPOSE)
+            if self.priv_validator is None:
+                return
+            address = self.priv_validator.get_pub_key().address()
+            if not rs.validators.has_address(address):
+                return
+            if self._is_proposer(address):
+                self.log.info("our turn to propose", height=height, round=round_)
+                await self.decide_proposal(height, round_)
+        finally:
+            self._update_round_step(round_, RoundStep.PROPOSE)
+            await self._new_step()
+            if self._is_proposal_complete():
+                await self.enter_prevote(height, self.rs.round)
+
+    def _is_proposer(self, address: bytes) -> bool:
+        return self.rs.validators.get_proposer().address == address
+
+    async def default_decide_proposal(self, height: int, round_: int) -> None:
+        """state.go:968."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            created = self._create_proposal_block()
+            if created is None:
+                return
+            block, block_parts = created
+
+        # flush WAL so replay recomputes the same proposal (state.go:986)
+        self.wal.flush_and_sync()
+
+        prop_block_id = BlockID(block.hash(), block_parts.header())
+        proposal = Proposal(
+            height=height,
+            round=round_,
+            pol_round=rs.valid_round,
+            block_id=prop_block_id,
+            timestamp_ns=time.time_ns(),
+        )
+        try:
+            self.priv_validator.sign_proposal(self.sm_state.chain_id, proposal)
+        except Exception as e:
+            if not self.replay_mode:
+                self.log.error("error signing proposal", height=height, round=round_, err=str(e))
+            return
+        self._send_internal_nowait({"type": "proposal", "proposal": proposal, "peer_id": ""})
+        for i in range(block_parts.total):
+            self._send_internal_nowait(
+                {
+                    "type": "block_part",
+                    "height": rs.height,
+                    "round": rs.round,
+                    "part": block_parts.get_part(i),
+                    "peer_id": "",
+                }
+            )
+        self.log.info("signed proposal", height=height, round=round_)
+
+    def _create_proposal_block(self) -> Optional[Tuple[Block, PartSet]]:
+        """state.go:1021."""
+        rs = self.rs
+        if rs.height == 1:
+            commit = Commit(0, 0, BlockID(), [])
+        elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+            commit = rs.last_commit.make_commit()
+        else:
+            self.log.error("cannot propose: no commit for the previous block")
+            return None
+        proposer_addr = self.priv_validator.get_pub_key().address()
+        block = self.block_exec.create_proposal_block(
+            rs.height, self.sm_state, commit, proposer_addr
+        )
+        parts = block.make_part_set(BLOCK_PART_SIZE_BYTES)
+        return block, parts
+
+    def _is_proposal_complete(self) -> bool:
+        """state.go:1000."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    async def enter_prevote(self, height: int, round_: int) -> None:
+        """state.go:1063."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PREVOTE
+        ):
+            return
+        self.log.debug("enterPrevote", height=height, round=round_)
+        try:
+            await self.do_prevote(height, round_)
+        finally:
+            self._update_round_step(round_, RoundStep.PREVOTE)
+            await self._new_step()
+
+    async def default_do_prevote(self, height: int, round_: int) -> None:
+        """state.go:1093."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self._sign_add_vote(PREVOTE_TYPE, rs.locked_block.hash(), rs.locked_block_parts.header())
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+            return
+        try:
+            self.block_exec.validate_block(self.sm_state, rs.proposal_block)
+        except Exception as e:
+            self.log.error("prevote: ProposalBlock is invalid", err=str(e))
+            self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+            return
+        self._sign_add_vote(
+            PREVOTE_TYPE, rs.proposal_block.hash(), rs.proposal_block_parts.header()
+        )
+
+    async def enter_prevote_wait(self, height: int, round_: int) -> None:
+        """state.go:1113."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PREVOTE_WAIT
+        ):
+            return
+        prevotes = rs.votes.prevotes(round_)
+        if prevotes is None or not prevotes.has_two_thirds_any():
+            raise RuntimeError(f"enterPrevoteWait({height}/{round_}) without +2/3 prevotes")
+        self._update_round_step(round_, RoundStep.PREVOTE_WAIT)
+        await self._new_step()
+        self._schedule_timeout(self.config.prevote(round_), height, round_, RoundStep.PREVOTE_WAIT)
+
+    async def enter_precommit(self, height: int, round_: int) -> None:
+        """state.go:1158."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PRECOMMIT
+        ):
+            return
+        self.log.debug("enterPrecommit", height=height, round=round_)
+
+        try:
+            prevotes = rs.votes.prevotes(round_)
+            block_id, ok = (prevotes.two_thirds_majority() if prevotes else (None, False))
+
+            if not ok:
+                # no polka: precommit nil
+                self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+                return
+
+            if self.event_bus:
+                await self.event_bus.publish_polka(rs.event_dict())
+
+            pol_round, _ = rs.votes.pol_info()
+            if pol_round < round_:
+                raise RuntimeError(f"POLRound should be {round_} but got {pol_round}")
+
+            if block_id.is_zero():
+                # +2/3 prevoted nil: unlock
+                if rs.locked_block is not None:
+                    rs.locked_round = -1
+                    rs.locked_block = None
+                    rs.locked_block_parts = None
+                    if self.event_bus:
+                        await self.event_bus.publish_unlock(rs.event_dict())
+                self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+                return
+
+            if rs.locked_block is not None and rs.locked_block.hashes_to(block_id.hash):
+                # relock
+                rs.locked_round = round_
+                if self.event_bus:
+                    await self.event_bus.publish_relock(rs.event_dict())
+                self._sign_add_vote(PRECOMMIT_TYPE, block_id.hash, block_id.parts_header)
+                return
+
+            if rs.proposal_block is not None and rs.proposal_block.hashes_to(block_id.hash):
+                # lock
+                self.block_exec.validate_block(self.sm_state, rs.proposal_block)
+                rs.locked_round = round_
+                rs.locked_block = rs.proposal_block
+                rs.locked_block_parts = rs.proposal_block_parts
+                if self.event_bus:
+                    await self.event_bus.publish_lock(rs.event_dict())
+                self._sign_add_vote(PRECOMMIT_TYPE, block_id.hash, block_id.parts_header)
+                return
+
+            # polka for a block we don't have: unlock, fetch, precommit nil
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                block_id.parts_header
+            ):
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet.from_header(block_id.parts_header)
+            if self.event_bus:
+                await self.event_bus.publish_unlock(rs.event_dict())
+            self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+        finally:
+            self._update_round_step(round_, RoundStep.PRECOMMIT)
+            await self._new_step()
+
+    async def enter_precommit_wait(self, height: int, round_: int) -> None:
+        """state.go:1262."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.triggered_timeout_precommit
+        ):
+            return
+        precommits = rs.votes.precommits(round_)
+        if precommits is None or not precommits.has_two_thirds_any():
+            raise RuntimeError(f"enterPrecommitWait({height}/{round_}) without +2/3 precommits")
+        rs.triggered_timeout_precommit = True
+        await self._new_step()
+        self._schedule_timeout(
+            self.config.precommit(round_), height, round_, RoundStep.PRECOMMIT_WAIT
+        )
+
+    async def enter_commit(self, height: int, commit_round: int) -> None:
+        """state.go:1288."""
+        rs = self.rs
+        if rs.height != height or rs.step >= RoundStep.COMMIT:
+            return
+        self.log.debug("enterCommit", height=height, commit_round=commit_round)
+        try:
+            block_id, ok = rs.votes.precommits(commit_round).two_thirds_majority()
+            if not ok:
+                raise RuntimeError("enterCommit expects +2/3 precommits")
+
+            if rs.locked_block is not None and rs.locked_block.hashes_to(block_id.hash):
+                rs.proposal_block = rs.locked_block
+                rs.proposal_block_parts = rs.locked_block_parts
+
+            if rs.proposal_block is None or not rs.proposal_block.hashes_to(block_id.hash):
+                if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                    block_id.parts_header
+                ):
+                    rs.proposal_block = None
+                    rs.proposal_block_parts = PartSet.from_header(block_id.parts_header)
+                    if self.event_bus:
+                        await self.event_bus.publish_valid_block(rs.event_dict())
+                    for cb in self.on_valid_block:
+                        cb(rs)
+        finally:
+            self._update_round_step(rs.round, RoundStep.COMMIT)
+            rs.commit_round = commit_round
+            rs.commit_time = time.monotonic()
+            await self._new_step()
+            await self.try_finalize_commit(height)
+
+    async def try_finalize_commit(self, height: int) -> None:
+        """state.go:1352."""
+        rs = self.rs
+        if rs.height != height:
+            raise RuntimeError(f"try_finalize_commit: height mismatch {rs.height} vs {height}")
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, ok = precommits.two_thirds_majority()
+        if not ok or block_id.is_zero():
+            return
+        if rs.proposal_block is None or not rs.proposal_block.hashes_to(block_id.hash):
+            return
+        await self.finalize_commit(height)
+
+    async def finalize_commit(self, height: int) -> None:
+        """state.go:1381 — save block, WAL end-height, ApplyBlock, advance."""
+        rs = self.rs
+        if rs.height != height or rs.step != RoundStep.COMMIT:
+            return
+        block_id, ok = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+        if not ok:
+            raise RuntimeError("cannot finalize commit: no +2/3 majority")
+        if not block_parts.has_header(block_id.parts_header):
+            raise RuntimeError("commit parts header mismatch")
+        if not block.hashes_to(block_id.hash):
+            raise RuntimeError("cannot finalize commit: proposal block does not hash to commit hash")
+        self.block_exec.validate_block(self.sm_state, block)
+
+        self.log.info(
+            "finalizing commit of block",
+            height=block.height,
+            hash=block.hash().hex()[:16],
+            txs=len(block.txs),
+        )
+        fail_point("finalize-pre-save")
+
+        if self.block_store.height() < block.height:
+            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+            self.block_store.save_block(block, block_parts, seen_commit)
+        fail_point("finalize-saved-block")
+
+        # end-height marker implies the block store has the block (wal.go:46)
+        self.wal.write_end_height(height)
+        fail_point("finalize-walled-endheight")
+
+        state_copy = self.sm_state.copy()
+        new_state, retain_height = await self.block_exec.apply_block(
+            state_copy, BlockID(block.hash(), block_parts.header()), block
+        )
+        fail_point("finalize-applied")
+
+        if retain_height > 0:
+            try:
+                base = self.block_store.base()
+                if retain_height > base:
+                    pruned = self.block_store.prune_blocks(retain_height)
+                    self.state_prune(retain_height)
+                    self.log.info("pruned blocks", pruned=pruned, retain_height=retain_height)
+            except Exception as e:
+                self.log.error("failed to prune blocks", err=str(e))
+
+        self.update_to_state(new_state)
+        self.schedule_round0()
+
+    def state_prune(self, retain_height: int) -> None:
+        self.block_exec.state_store.prune_states(retain_height)
+
+    # ------------------------------------------------------------------
+    # proposal + block parts
+    # ------------------------------------------------------------------
+    async def default_set_proposal(self, proposal: Proposal) -> None:
+        """state.go:1600."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+            0 <= proposal.pol_round and proposal.pol_round >= proposal.round
+        ):
+            raise InvalidProposalPOLRoundError("invalid proposal POL round")
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify(
+            proposal.sign_bytes(self.sm_state.chain_id), proposal.signature
+        ):
+            raise InvalidProposalSignatureError("invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet.from_header(proposal.block_id.parts_header)
+        self.log.info("received proposal", height=proposal.height, round=proposal.round)
+
+    async def _add_proposal_block_part(
+        self, height: int, round_: int, part: Part, peer_id: str
+    ) -> bool:
+        """state.go:1636."""
+        rs = self.rs
+        if rs.height != height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        try:
+            added = rs.proposal_block_parts.add_part(part)
+        except PartSetError:
+            if round_ != rs.round:
+                return False  # wrong-round part, not necessarily malicious
+            raise
+        if added and rs.proposal_block_parts.is_complete():
+            rs.proposal_block = Block.deserialize(rs.proposal_block_parts.assemble())
+            self.log.info(
+                "received complete proposal block",
+                height=rs.proposal_block.height,
+                hash=rs.proposal_block.hash().hex()[:16],
+            )
+            if self.event_bus:
+                await self.event_bus.publish_complete_proposal(rs.event_dict())
+
+            prevotes = rs.votes.prevotes(rs.round)
+            block_id, has_two_thirds = (
+                prevotes.two_thirds_majority() if prevotes else (None, False)
+            )
+            if has_two_thirds and not block_id.is_zero() and rs.valid_round < rs.round:
+                if rs.proposal_block.hashes_to(block_id.hash):
+                    rs.valid_round = rs.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+
+            if rs.step <= RoundStep.PROPOSE and self._is_proposal_complete():
+                await self.enter_prevote(height, rs.round)
+                if has_two_thirds:
+                    await self.enter_precommit(height, rs.round)
+            elif rs.step == RoundStep.COMMIT:
+                await self.try_finalize_commit(height)
+        return added
+
+    # ------------------------------------------------------------------
+    # votes
+    # ------------------------------------------------------------------
+    async def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """state.go:1706."""
+        try:
+            return await self._add_vote(vote, peer_id)
+        except VoteHeightMismatchError:
+            return False
+        except ErrVoteConflictingVotes as e:
+            if self.priv_validator is not None and (
+                vote.validator_address == self.priv_validator.get_pub_key().address()
+            ):
+                self.log.error(
+                    "found conflicting vote from ourselves; did you unsafe-reset a validator?",
+                    height=vote.height,
+                    round=vote.round,
+                )
+                raise
+            if self.evidence_pool is not None and e.evidence is not None:
+                self.evidence_pool.add_evidence(e.evidence)
+            return False
+
+    async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """state.go:1751."""
+        rs = self.rs
+
+        # precommit straggler for the previous height during NEW_HEIGHT
+        if vote.height + 1 == rs.height:
+            if not (rs.step == RoundStep.NEW_HEIGHT and vote.type == PRECOMMIT_TYPE):
+                raise VoteHeightMismatchError("wrong height, not a LastCommit straggler")
+            added = rs.last_commit.add_vote(vote)
+            if not added:
+                return False
+            self.log.debug("added to lastPrecommits")
+            await self._publish_vote(vote)
+            if self.config.skip_timeout_commit and rs.last_commit.has_all():
+                await self.enter_new_round(rs.height, 0)
+            return True
+
+        if vote.height != rs.height:
+            raise VoteHeightMismatchError(f"vote height {vote.height} != {rs.height}")
+
+        height = rs.height
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        await self._publish_vote(vote)
+
+        if vote.type == PREVOTE_TYPE:
+            prevotes = rs.votes.prevotes(vote.round)
+            block_id, ok = prevotes.two_thirds_majority()
+            if ok:
+                # unlock on newer polka (state.go:1832)
+                if (
+                    rs.locked_block is not None
+                    and rs.locked_round < vote.round <= rs.round
+                    and not rs.locked_block.hashes_to(block_id.hash)
+                ):
+                    rs.locked_round = -1
+                    rs.locked_block = None
+                    rs.locked_block_parts = None
+                    if self.event_bus:
+                        await self.event_bus.publish_unlock(rs.event_dict())
+                # update valid block (state.go:1849)
+                if (
+                    not block_id.is_zero()
+                    and rs.valid_round < vote.round
+                    and vote.round == rs.round
+                ):
+                    if rs.proposal_block is not None and rs.proposal_block.hashes_to(block_id.hash):
+                        rs.valid_round = vote.round
+                        rs.valid_block = rs.proposal_block
+                        rs.valid_block_parts = rs.proposal_block_parts
+                    else:
+                        rs.proposal_block = None
+                    if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                        block_id.parts_header
+                    ):
+                        rs.proposal_block_parts = PartSet.from_header(block_id.parts_header)
+                    for cb in self.on_valid_block:
+                        cb(rs)
+                    if self.event_bus:
+                        await self.event_bus.publish_valid_block(rs.event_dict())
+
+            if rs.round < vote.round and prevotes.has_two_thirds_any():
+                await self.enter_new_round(height, vote.round)  # round skip
+            elif rs.round == vote.round and rs.step >= RoundStep.PREVOTE:
+                block_id, ok = prevotes.two_thirds_majority()
+                if ok and (self._is_proposal_complete() or block_id.is_zero()):
+                    await self.enter_precommit(height, vote.round)
+                elif prevotes.has_two_thirds_any():
+                    await self.enter_prevote_wait(height, vote.round)
+            elif rs.proposal is not None and 0 <= rs.proposal.pol_round == vote.round:
+                if self._is_proposal_complete():
+                    await self.enter_prevote(height, rs.round)
+
+        elif vote.type == PRECOMMIT_TYPE:
+            precommits = rs.votes.precommits(vote.round)
+            block_id, ok = precommits.two_thirds_majority()
+            if ok:
+                await self.enter_new_round(height, vote.round)
+                await self.enter_precommit(height, vote.round)
+                if not block_id.is_zero():
+                    await self.enter_commit(height, vote.round)
+                    if self.config.skip_timeout_commit and precommits.has_all():
+                        await self.enter_new_round(self.rs.height, 0)
+                else:
+                    await self.enter_precommit_wait(height, vote.round)
+            elif rs.round <= vote.round and precommits.has_two_thirds_any():
+                await self.enter_new_round(height, vote.round)
+                await self.enter_precommit_wait(height, vote.round)
+        else:
+            raise ValueError(f"unexpected vote type {vote.type}")
+        return True
+
+    async def _publish_vote(self, vote: Vote) -> None:
+        if self.event_bus:
+            await self.event_bus.publish_vote(vote)
+        for cb in self.on_vote:
+            cb(vote)
+
+    # -- signing -----------------------------------------------------------
+    def _sign_vote(self, msg_type: int, hash_: bytes, header: PartSetHeader) -> Vote:
+        """state.go:1922."""
+        self.wal.flush_and_sync()
+        pub_key = self.priv_validator.get_pub_key()
+        addr = pub_key.address()
+        val_idx, _ = self.rs.validators.get_by_address(addr)
+        vote = Vote(
+            type=msg_type,
+            height=self.rs.height,
+            round=self.rs.round,
+            block_id=BlockID(hash_, header),
+            timestamp_ns=self._vote_time(),
+            validator_address=addr,
+            validator_index=val_idx,
+        )
+        self.priv_validator.sign_vote(self.sm_state.chain_id, vote)
+        return vote
+
+    def _vote_time(self) -> int:
+        """BFT-time monotonicity (state.go:1952)."""
+        now = time.time_ns()
+        min_time = now
+        iota_ns = self.sm_state.consensus_params.block.time_iota_ms * 1_000_000
+        if self.rs.locked_block is not None:
+            min_time = self.rs.locked_block.time_ns + iota_ns
+        elif self.rs.proposal_block is not None:
+            min_time = self.rs.proposal_block.time_ns + iota_ns
+        return max(now, min_time)
+
+    def _sign_add_vote(self, msg_type: int, hash_: bytes, header: PartSetHeader) -> Optional[Vote]:
+        """state.go:1961."""
+        if self.priv_validator is None:
+            return None
+        pub_key = self.priv_validator.get_pub_key()
+        if not self.rs.validators.has_address(pub_key.address()):
+            return None
+        try:
+            vote = self._sign_vote(msg_type, hash_, header)
+        except Exception as e:
+            if not self.replay_mode:
+                self.log.error("error signing vote", err=str(e))
+            return None
+        self._send_internal_nowait({"type": "vote", "vote": vote, "peer_id": ""})
+        self.log.debug("signed and pushed vote", height=self.rs.height, round=self.rs.round)
+        return vote
+
+    # ------------------------------------------------------------------
+    # height housekeeping
+    # ------------------------------------------------------------------
+    def update_to_state(self, state: SMState) -> None:
+        """state.go:505."""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height != state.last_block_height:
+            raise RuntimeError(
+                f"update_to_state expected height {rs.height}, got {state.last_block_height}"
+            )
+        if (
+            self.sm_state is not None
+            and not self.sm_state.is_empty()
+            and self.sm_state.last_block_height + 1 != rs.height
+        ):
+            raise RuntimeError("inconsistent sm_state height vs rs height")
+
+        if (
+            self.sm_state is not None
+            and not self.sm_state.is_empty()
+            and state.last_block_height <= self.sm_state.last_block_height
+        ):
+            # SwitchToConsensus with stale state — just re-signal
+            return
+
+        last_precommits = None
+        if rs.commit_round > -1 and rs.votes is not None:
+            pc = rs.votes.precommits(rs.commit_round)
+            if pc is None or not pc.has_two_thirds_majority():
+                raise RuntimeError("update_to_state called but last precommit round lacks +2/3")
+            last_precommits = pc
+
+        height = state.last_block_height + 1
+        rs.height = height
+        self._update_round_step(0, RoundStep.NEW_HEIGHT)
+        now = time.monotonic()
+        base = rs.commit_time if rs.commit_time else now
+        rs.start_time = self.config.commit(base)
+        rs.validators = state.validators
+        rs.proposal = None
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        rs.votes = HeightVoteSet(state.chain_id, height, state.validators)
+        rs.commit_round = -1
+        rs.last_commit = last_precommits
+        rs.last_validators = state.last_validators
+        rs.triggered_timeout_precommit = False
+        self.sm_state = state
+
+    def _update_round_step(self, round_: int, step: int) -> None:
+        self.rs.round = round_
+        self.rs.step = step
+
+    async def _new_step(self) -> None:
+        """state.go:590 newStep: WAL the round state + notify."""
+        self.wal.write({"type": "roundstate", **self.rs.event_dict()})
+        self.n_steps += 1
+        if self.event_bus:
+            await self.event_bus.publish_new_round_step(self.rs.event_dict())
+        for cb in self.on_new_round_step:
+            cb(self.rs)
+
+    def schedule_round0(self) -> None:
+        """state.go:466 — enter_new_round(height, 0) at start_time."""
+        sleep = self.rs.start_time - time.monotonic()
+        self._schedule_timeout(sleep, self.rs.height, 0, RoundStep.NEW_HEIGHT)
+
+    def _schedule_timeout(self, duration: float, height: int, round_: int, step: int) -> None:
+        self.timeout_ticker.schedule_timeout(TimeoutInfo(duration, height, round_, step))
+
+    # -- introspection (RPC dump_consensus_state) --------------------------
+    def get_round_state(self) -> RoundState:
+        return self.rs
+
+    def load_commit(self, height: int) -> Optional[Commit]:
+        if height == self.block_store.height():
+            return self.block_store.load_seen_commit(height)
+        return self.block_store.load_block_commit(height)
+
+
+def commit_to_vote_set(chain_id: str, commit: Commit, vals) -> VoteSet:
+    """types/block.go:586 CommitToVoteSet."""
+    vote_set = VoteSet(chain_id, commit.height, commit.round, PRECOMMIT_TYPE, vals)
+    for idx, cs in enumerate(commit.signatures):
+        if cs.is_absent():
+            continue
+        added = vote_set.add_vote(commit.get_vote(idx))
+        if not added:
+            raise RuntimeError("failed to reconstruct LastCommit")
+    return vote_set
+
+
+def _wire_msg(mi: dict) -> dict:
+    """WAL-serializable form of a consensus message."""
+    kind = mi["type"]
+    if kind == "vote":
+        return {"type": "vote", "vote": mi["vote"].to_dict()}
+    if kind == "proposal":
+        return {"type": "proposal", "proposal": mi["proposal"].to_dict()}
+    if kind == "block_part":
+        return {
+            "type": "block_part",
+            "height": mi["height"],
+            "round": mi["round"],
+            "part": mi["part"].to_dict(),
+        }
+    return {"type": kind}

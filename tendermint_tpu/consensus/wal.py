@@ -1,0 +1,134 @@
+"""Consensus write-ahead log.
+
+Reference parity: consensus/wal.go (WAL iface:64, BaseWAL:82, Write:184,
+WriteSync:201, SearchForEndHeight:231, WALEncoder.Encode:302 crc32+length
+framing, WALDecoder:347, nilWAL:404).
+
+Record framing: crc32(payload) u32 BE | length u32 BE | msgpack payload.
+Payload = {"type": "msg"|"timeout"|"roundstate"|"endheight",
+           "time_ns": int, ...}.  Every consensus input is logged before
+processing; own messages fsync (WriteSync) so a crash can never produce a
+double-sign after replay.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+import zlib
+from typing import Iterator, List, Optional, Tuple
+
+from ..encoding import codec
+from ..libs.autofile import Group
+
+_HEADER = struct.Struct(">II")
+MAX_RECORD_BYTES = 10 * 1024 * 1024  # > max block part msg
+
+
+class WALCorruptionError(Exception):
+    pass
+
+
+def encode_record(payload: dict) -> bytes:
+    data = codec.dumps(payload)
+    return _HEADER.pack(zlib.crc32(data) & 0xFFFFFFFF, len(data)) + data
+
+
+def decode_records(raw: bytes) -> Iterator[dict]:
+    """Yield records; raises WALCorruptionError on bad crc/length; a
+    truncated tail record (torn write at crash) ends iteration cleanly."""
+    pos = 0
+    n = len(raw)
+    while pos < n:
+        if n - pos < _HEADER.size:
+            return  # torn header at EOF
+        crc, length = _HEADER.unpack_from(raw, pos)
+        if length > MAX_RECORD_BYTES:
+            raise WALCorruptionError(f"record length {length} exceeds max")
+        if n - pos - _HEADER.size < length:
+            return  # torn payload at EOF
+        data = raw[pos + _HEADER.size : pos + _HEADER.size + length]
+        if zlib.crc32(data) & 0xFFFFFFFF != crc:
+            raise WALCorruptionError(f"crc mismatch at offset {pos}")
+        yield codec.loads(data)
+        pos += _HEADER.size + length
+
+
+class WAL:
+    def __init__(self, head_path: str, head_size_limit: int = 10 * 1024 * 1024):
+        self.group = Group(head_path, head_size_limit=head_size_limit)
+        self.flush_interval = 2.0
+        self._last_flush = 0.0
+
+    # -- writing -----------------------------------------------------------
+    def write(self, payload: dict) -> None:
+        """Buffered write (peer messages; wal.go:184)."""
+        payload.setdefault("time_ns", time.time_ns())
+        self.group.write(encode_record(payload))
+        now = time.monotonic()
+        if now - self._last_flush > self.flush_interval:
+            self.group.flush()
+            self._last_flush = now
+
+    def write_sync(self, payload: dict) -> None:
+        """fsync'd write (own messages + end-height; wal.go:201)."""
+        payload.setdefault("time_ns", time.time_ns())
+        self.group.write(encode_record(payload))
+        self.group.sync()
+        self.group.maybe_rotate()
+
+    def flush_and_sync(self) -> None:
+        self.group.sync()
+
+    def write_end_height(self, height: int) -> None:
+        self.write_sync({"type": "endheight", "height": height})
+
+    # -- reading -----------------------------------------------------------
+    def all_records(self) -> List[dict]:
+        return list(decode_records(self.group.read_all()))
+
+    def search_for_end_height(self, height: int) -> Tuple[Optional[List[dict]], bool]:
+        """Records AFTER the EndHeight(height) marker, or (None, False)
+        (wal.go:231).  height=0 accepts a fresh WAL (no marker needed)."""
+        records = self.all_records()
+        if height == 0:
+            # gr.CurHeight == 0 special case: start of WAL counts as marker
+            found = True
+            start = 0
+            for i, rec in enumerate(records):
+                if rec.get("type") == "endheight" and rec.get("height", -1) >= height:
+                    start = i + 1
+            return records[start:], found
+        for i in range(len(records) - 1, -1, -1):
+            rec = records[i]
+            if rec.get("type") == "endheight" and rec.get("height") == height:
+                return records[i + 1 :], True
+        return None, False
+
+    def close(self) -> None:
+        self.group.close()
+
+
+class NilWAL:
+    """wal.go:404 — disabled WAL."""
+
+    def write(self, payload: dict) -> None:
+        pass
+
+    def write_sync(self, payload: dict) -> None:
+        pass
+
+    def flush_and_sync(self) -> None:
+        pass
+
+    def write_end_height(self, height: int) -> None:
+        pass
+
+    def all_records(self):
+        return []
+
+    def search_for_end_height(self, height: int):
+        return None, False
+
+    def close(self) -> None:
+        pass

@@ -50,28 +50,38 @@ def _bucket_size(n: int, multiple_of: int = 1) -> int:
 _N_LIMBS = 15
 _LIMB_BITS = 17
 
-_decompress_cache: Dict[bytes, Optional[np.ndarray]] = {}
+# Bounded LRU: pubkeys are attacker-suppliable (mempool/evidence paths), so
+# the cache must not grow without limit.  64k entries ≈ 32 MB worst case.
+_DECOMPRESS_CACHE_MAX = 65536
+import collections as _collections
+
+_decompress_cache: "_collections.OrderedDict[bytes, Optional[np.ndarray]]" = (
+    _collections.OrderedDict()
+)
 
 
 def _neg_a_limbs(pubkey: bytes) -> Optional[np.ndarray]:
     """Decompress pubkey and return extended coords of −A as [4, 15] int64
-    limbs; None for invalid encodings.  Cached — the pubkey table is hot."""
-    cached = _decompress_cache.get(pubkey)
-    if cached is not None or pubkey in _decompress_cache:
-        return cached
+    limbs; None for invalid encodings.  LRU-cached — validator pubkeys are
+    hot across heights."""
+    if pubkey in _decompress_cache:
+        _decompress_cache.move_to_end(pubkey)
+        return _decompress_cache[pubkey]
     aff = em.decompress(pubkey)
     if aff is None:
-        _decompress_cache[pubkey] = None
-        return None
-    x, y = aff
-    nx = (em.P - x) % em.P
-    ext = (nx, y, 1, nx * y % em.P)
-    limbs = np.zeros((4, _N_LIMBS), dtype=np.int64)
-    for c in range(4):
-        v = ext[c]
-        for i in range(_N_LIMBS):
-            limbs[c, i] = (v >> (_LIMB_BITS * i)) & ((1 << _LIMB_BITS) - 1)
+        limbs = None
+    else:
+        x, y = aff
+        nx = (em.P - x) % em.P
+        ext = (nx, y, 1, nx * y % em.P)
+        limbs = np.zeros((4, _N_LIMBS), dtype=np.int64)
+        for c in range(4):
+            v = ext[c]
+            for i in range(_N_LIMBS):
+                limbs[c, i] = (v >> (_LIMB_BITS * i)) & ((1 << _LIMB_BITS) - 1)
     _decompress_cache[pubkey] = limbs
+    if len(_decompress_cache) > _DECOMPRESS_CACHE_MAX:
+        _decompress_cache.popitem(last=False)
     return limbs
 
 
@@ -93,6 +103,48 @@ def _r_limbs_and_sign(r_bytes: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return limbs, sign
 
 
+def _scalar_rows(
+    items: Sequence[Optional[Tuple[bytes, bytes, bytes]]],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Shared per-signature host prep: SHA-512 h, scalar s, raw R limbs,
+    canonical-S / length prefilters.  `items[i]` is (pubkey, msg, sig) or
+    None when the caller already knows entry i is invalid.  Returns
+    (h_bits, s_bits, r_y_raw, r_sign, valid)."""
+    n = len(items)
+    h_be = np.zeros((n, 32), dtype=np.uint8)
+    s_be = np.zeros((n, 32), dtype=np.uint8)
+    r_le = np.zeros((n, 32), dtype=np.uint8)
+    valid = np.zeros(n, dtype=bool)
+    for i, item in enumerate(items):
+        if item is None:
+            continue
+        pk, msg, sig = item
+        if len(sig) != 64 or not em.sc_minimal(sig[32:]):
+            continue
+        h = em.compute_hram(sig[:32], pk, msg)
+        h_be[i] = np.frombuffer(h.to_bytes(32, "big"), dtype=np.uint8)
+        s = int.from_bytes(sig[32:], "little")
+        s_be[i] = np.frombuffer(s.to_bytes(32, "big"), dtype=np.uint8)
+        r_le[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+        valid[i] = True
+    r_y_raw, r_sign = _r_limbs_and_sign(r_le)
+    return _msb_bits(h_be), _msb_bits(s_be), r_y_raw, r_sign, valid
+
+
+def _pad_scalar_rows(b: int, h_bits, s_bits, r_y, r_sign):
+    """Pad the per-signature arrays up to bucket size b."""
+    n = h_bits.shape[0]
+    pad = b - n
+    if pad <= 0:
+        return h_bits, s_bits, r_y, r_sign
+    return (
+        np.concatenate([h_bits, np.zeros((pad, 256), dtype=np.int64)]),
+        np.concatenate([s_bits, np.zeros((pad, 256), dtype=np.int64)]),
+        np.concatenate([r_y, np.zeros((pad, _N_LIMBS), dtype=np.int64)]),
+        np.concatenate([r_sign, np.zeros(pad, dtype=np.int64)]),
+    )
+
+
 def prepare_batch(
     pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
@@ -102,29 +154,17 @@ def prepare_batch(
     neg_a = np.zeros((n, 4, _N_LIMBS), dtype=np.int64)
     neg_a[:, 1, :1] = 1  # identity placeholder (0,1,1,0): y=z=1
     neg_a[:, 2, :1] = 1
-    h_be = np.zeros((n, 32), dtype=np.uint8)
-    s_be = np.zeros((n, 32), dtype=np.uint8)
-    r_le = np.zeros((n, 32), dtype=np.uint8)
-    valid = np.zeros(n, dtype=bool)
-
+    items: list = [None] * n
     for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
-        if len(sig) != 64 or len(pk) != 32:
-            continue
-        if not em.sc_minimal(sig[32:]):
+        if len(pk) != 32:
             continue
         limbs = _neg_a_limbs(pk)
         if limbs is None:
             continue
         neg_a[i] = limbs
-        h = em.compute_hram(sig[:32], pk, msg)
-        h_be[i] = np.frombuffer(h.to_bytes(32, "big"), dtype=np.uint8)
-        s = int.from_bytes(sig[32:], "little")
-        s_be[i] = np.frombuffer(s.to_bytes(32, "big"), dtype=np.uint8)
-        r_le[i] = np.frombuffer(sig[:32], dtype=np.uint8)
-        valid[i] = True
-
-    r_y_raw, r_sign = _r_limbs_and_sign(r_le)
-    return neg_a, _msb_bits(h_be), _msb_bits(s_be), r_y_raw, r_sign, valid
+        items[i] = (pk, msg, sig)
+    h_bits, s_bits, r_y_raw, r_sign, valid = _scalar_rows(items)
+    return neg_a, h_bits, s_bits, r_y_raw, r_sign, valid
 
 
 # ---------------------------------------------------------------------------
@@ -179,13 +219,9 @@ class BatchVerifier:
         if not valid.any():
             return [False] * n
         b = _bucket_size(n, self._pad_multiple())
-        pad = b - n
-        if pad:
-            neg_a = np.concatenate([neg_a, np.tile(neg_a[-1:], (pad, 1, 1))])
-            h_bits = np.concatenate([h_bits, np.zeros((pad, 256), dtype=np.int64)])
-            s_bits = np.concatenate([s_bits, np.zeros((pad, 256), dtype=np.int64)])
-            r_y = np.concatenate([r_y, np.zeros((pad, _N_LIMBS), dtype=np.int64)])
-            r_sign = np.concatenate([r_sign, np.zeros(pad, dtype=np.int64)])
+        if b > n:
+            neg_a = np.concatenate([neg_a, np.tile(neg_a[-1:], (b - n, 1, 1))])
+        h_bits, s_bits, r_y, r_sign = _pad_scalar_rows(b, h_bits, s_bits, r_y, r_sign)
         ok = np.asarray(self._jitted()(neg_a, h_bits, s_bits, r_y, r_sign))[:n]
         return list(np.logical_and(ok, valid))
 
@@ -231,35 +267,20 @@ class PubkeyTable:
         if n == 0:
             return []
         idx_arr = np.asarray(idxs, dtype=np.int64)
-        # Host prep for everything except pubkey limbs (gathered on device).
-        h_be = np.zeros((n, 32), dtype=np.uint8)
-        s_be = np.zeros((n, 32), dtype=np.uint8)
-        r_le = np.zeros((n, 32), dtype=np.uint8)
-        valid = np.zeros(n, dtype=bool)
+        # Host prep for everything except pubkey limbs (gathered on device);
+        # entries with bad indices are marked invalid up front.
+        items: list = [None] * n
         for i, (idx, msg, sig) in enumerate(zip(idx_arr, msgs, sigs)):
-            if len(sig) != 64 or idx < 0 or idx >= len(self.pubkeys):
-                continue
-            if not self.row_valid[idx] or not em.sc_minimal(sig[32:]):
-                continue
-            h = em.compute_hram(sig[:32], self.pubkeys[idx], msg)
-            h_be[i] = np.frombuffer(h.to_bytes(32, "big"), dtype=np.uint8)
-            s = int.from_bytes(sig[32:], "little")
-            s_be[i] = np.frombuffer(s.to_bytes(32, "big"), dtype=np.uint8)
-            r_le[i] = np.frombuffer(sig[:32], dtype=np.uint8)
-            valid[i] = True
+            if 0 <= idx < len(self.pubkeys) and self.row_valid[idx]:
+                items[i] = (self.pubkeys[idx], msg, sig)
+        h_bits, s_bits, r_y, r_sign, valid = _scalar_rows(items)
         if not valid.any():
             return [False] * n
 
-        r_y, r_sign = _r_limbs_and_sign(r_le)
-        h_bits, s_bits = _msb_bits(h_be), _msb_bits(s_be)
         b = _bucket_size(n, self.verifier._pad_multiple())
-        pad = b - n
-        if pad:
-            idx_arr = np.concatenate([idx_arr, np.zeros(pad, dtype=np.int64)])
-            h_bits = np.concatenate([h_bits, np.zeros((pad, 256), dtype=np.int64)])
-            s_bits = np.concatenate([s_bits, np.zeros((pad, 256), dtype=np.int64)])
-            r_y = np.concatenate([r_y, np.zeros((pad, _N_LIMBS), dtype=np.int64)])
-            r_sign = np.concatenate([r_sign, np.zeros(pad, dtype=np.int64)])
+        h_bits, s_bits, r_y, r_sign = _pad_scalar_rows(b, h_bits, s_bits, r_y, r_sign)
+        if b > n:
+            idx_arr = np.concatenate([idx_arr, np.zeros(b - n, dtype=np.int64)])
         idx_arr = np.clip(idx_arr, 0, len(self.pubkeys) - 1)
         neg_a = jnp.take(self.neg_a_rows, jnp.asarray(idx_arr), axis=0)
         ok = np.asarray(self.verifier._jitted()(neg_a, h_bits, s_bits, r_y, r_sign))[:n]
@@ -333,7 +354,15 @@ class AsyncBatchVerifier(Service):
             sigs = [b[2] for b in batch]
             # The jitted call blocks this thread; consensus is itself awaiting
             # these futures, so running inline keeps ordering deterministic.
-            results = self.verifier.verify(pubkeys, msgs, sigs)
+            try:
+                results = self.verifier.verify(pubkeys, msgs, sigs)
+            except Exception as e:
+                # a dead flusher would strand every pending + future caller;
+                # fail this batch's futures and keep the loop alive
+                for _, _, _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(RuntimeError(f"batch verify failed: {e!r}"))
+                continue
             for (_, _, _, fut), ok in zip(batch, results):
                 if not fut.done():
                     fut.set_result(bool(ok))

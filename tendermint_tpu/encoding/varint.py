@@ -35,6 +35,20 @@ def decode_uvarint(data: bytes, offset: int = 0) -> tuple[int, int]:
             raise ValueError("uvarint too long")
 
 
+async def decode_uvarint_stream(reader) -> int:
+    """Read one uvarint from an asyncio.StreamReader (socket framing)."""
+    result = 0
+    shift = 0
+    while True:
+        b = (await reader.readexactly(1))[0]
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result
+        shift += 7
+        if shift > 70:
+            raise ValueError("uvarint too long")
+
+
 def encode_svarint(n: int) -> bytes:
     # zigzag
     return encode_uvarint((n << 1) ^ (n >> 63) if n < 0 else n << 1)

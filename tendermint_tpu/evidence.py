@@ -1,0 +1,112 @@
+"""Evidence pool: stores and validates misbehaviour evidence.
+
+Reference parity: evidence/pool.go (Pool:18, AddEvidence:98, Update:76,
+PendingEvidence:64, MarkEvidenceAsCommitted, IsCommitted) and
+evidence/store.go key scheme.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .encoding import codec
+from .libs.kvstore import KVStore
+from .libs.log import get_logger
+from .state.validation import verify_evidence
+from .types import Block
+from .types.evidence import Evidence
+
+
+def _k_pending(height: int, ev_hash: bytes) -> bytes:
+    return b"evp/%020d/" % height + ev_hash.hex().encode()
+
+
+def _k_committed(ev_hash: bytes) -> bytes:
+    return b"evc/" + ev_hash.hex().encode()
+
+
+class EvidencePool:
+    def __init__(self, db: KVStore, state_store, state=None):
+        self.db = db
+        self.state_store = state_store
+        self.state = state  # updated via update()
+        self.log = get_logger("evidence")
+        # new-evidence callbacks (reactor gossip hook)
+        self.on_evidence = []
+
+    def set_state(self, state) -> None:
+        self.state = state
+
+    # -- ingress -----------------------------------------------------------
+    def add_evidence(self, ev: Evidence) -> None:
+        """evidence/pool.go:98 — verify, dedup, persist, notify."""
+        if self.is_committed(ev) or self.is_pending(ev):
+            return
+        if self.state is not None:
+            verify_evidence(self.state, ev, self.state_store)
+        self.db.set(_k_pending(ev.height(), ev.hash()), codec.dumps(ev))
+        self.log.info("verified new evidence of byzantine behaviour", evidence=repr(ev))
+        for cb in self.on_evidence:
+            cb(ev)
+
+    # -- queries -----------------------------------------------------------
+    def pending_evidence(self, max_num: int = -1) -> List[Evidence]:
+        """evidence/pool.go:64."""
+        out = []
+        for _, raw in self.db.iterate_prefix(b"evp/"):
+            out.append(codec.loads(raw))
+            if 0 <= max_num <= len(out):
+                break
+        return out
+
+    def is_pending(self, ev: Evidence) -> bool:
+        return self.db.has(_k_pending(ev.height(), ev.hash()))
+
+    def is_committed(self, ev: Evidence) -> bool:
+        return self.db.has(_k_committed(ev.hash()))
+
+    # -- post-commit -------------------------------------------------------
+    def update(self, block: Block, state) -> None:
+        """evidence/pool.go:76 — mark block evidence committed, drop
+        expired pending evidence."""
+        self.state = state
+        for ev in block.evidence:
+            self.mark_committed(ev)
+        self._prune_expired(state)
+
+    def mark_committed(self, ev: Evidence) -> None:
+        self.db.write_batch(
+            [(_k_committed(ev.hash()), b"1")],
+            deletes=[_k_pending(ev.height(), ev.hash())],
+        )
+
+    def _prune_expired(self, state) -> None:
+        params = state.consensus_params.evidence
+        deletes = []
+        for key, raw in self.db.iterate_prefix(b"evp/"):
+            ev = codec.loads(raw)
+            too_old_blocks = state.last_block_height - ev.height() > params.max_age_num_blocks
+            too_old_time = state.last_block_time_ns - ev.time_ns() > params.max_age_duration_ns
+            if too_old_blocks and too_old_time:
+                deletes.append(key)
+        if deletes:
+            self.db.write_batch([], deletes)
+
+
+class NopEvidencePool:
+    """state/services.go MockEvidencePool equivalent."""
+
+    def add_evidence(self, ev) -> None:
+        pass
+
+    def pending_evidence(self, max_num: int = -1):
+        return []
+
+    def is_committed(self, ev) -> bool:
+        return False
+
+    def is_pending(self, ev) -> bool:
+        return False
+
+    def update(self, block, state) -> None:
+        pass

@@ -31,3 +31,9 @@ def fail() -> None:
         sys.stderr.write(f"*** fail-point {_call_index} tripped — exiting\n")
         sys.stderr.flush()
         os._exit(1)
+
+
+def fail_point(label: str = "") -> None:
+    """Named fail point; label is informational (call order defines the
+    index, as in the reference)."""
+    fail()

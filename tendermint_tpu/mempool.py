@@ -1,0 +1,268 @@
+"""Mempool: ordered tx pool with app-side validation and recheck.
+
+Reference parity: mempool/clist_mempool.go (CheckTx:213, Update:529,
+recheckTxs:591, ReapMaxBytesMaxGas:471, mapTxCache:641) + the
+mempool/mempool.go interface.  The reference's concurrent linked list
+becomes an insertion-ordered dict guarded by the event loop (single-task
+mutation) plus an asyncio lock for the commit window.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from .abci import types as abci
+from .libs.log import get_logger
+from .types.tx import tx_hash
+
+
+class MempoolError(Exception):
+    pass
+
+
+class TxInCacheError(MempoolError):
+    """mempool/errors.go ErrTxInCache."""
+
+    def __init__(self):
+        super().__init__("tx already exists in cache")
+
+
+class MempoolFullError(MempoolError):
+    def __init__(self, n_txs: int, total_bytes: int):
+        super().__init__(f"mempool is full: {n_txs} txs, {total_bytes} bytes")
+
+
+@dataclass
+class MempoolTx:
+    """mempool/clist_mempool.go:616 mempoolTx."""
+
+    tx: bytes
+    height: int  # height when validated
+    gas_wanted: int
+    senders: set  # peer ids that sent us this tx (mempoolIDs analogue)
+
+
+class TxCache:
+    """LRU dedup cache (mapTxCache, clist_mempool.go:641)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._map: "collections.OrderedDict[bytes, None]" = collections.OrderedDict()
+
+    def push(self, tx: bytes) -> bool:
+        """False if already present."""
+        key = tx_hash(tx)
+        if key in self._map:
+            self._map.move_to_end(key)
+            return False
+        if len(self._map) >= self.size:
+            self._map.popitem(last=False)
+        self._map[key] = None
+        return True
+
+    def remove(self, tx: bytes) -> None:
+        self._map.pop(tx_hash(tx), None)
+
+    def reset(self) -> None:
+        self._map.clear()
+
+
+class Mempool:
+    def __init__(
+        self,
+        proxy_app,  # abci Client (mempool connection)
+        config=None,
+        height: int = 0,
+    ):
+        cfg = config or {}
+        self.proxy_app = proxy_app
+        self.size_limit = cfg.get("size", 5000)
+        self.max_txs_bytes = cfg.get("max_txs_bytes", 1024 * 1024 * 1024)
+        self.max_tx_bytes = cfg.get("max_tx_bytes", 1024 * 1024)
+        self.recheck = cfg.get("recheck", True)
+        self.keep_invalid_txs_in_cache = cfg.get("keep_invalid_txs_in_cache", False)
+        self.cache = TxCache(cfg.get("cache_size", 10000))
+        self.height = height
+        self.txs: "Dict[bytes, MempoolTx]" = {}  # insertion-ordered
+        self.txs_bytes = 0
+        self._lock = asyncio.Lock()
+        self._tx_available: Optional[asyncio.Event] = None
+        self.notified_txs_available = False
+        self.pre_check: Optional[Callable[[bytes], Optional[str]]] = None
+        self.post_check = None
+        self.log = get_logger("mempool")
+
+    # -- locking (commit window) ------------------------------------------
+    def lock(self):
+        return self._lock
+
+    async def flush_app_conn(self) -> None:
+        await self.proxy_app.flush()
+
+    # -- tx availability signal (consensus WaitForTxs) ---------------------
+    def enable_txs_available(self) -> None:
+        self._tx_available = asyncio.Event()
+
+    def txs_available(self) -> Optional[asyncio.Event]:
+        return self._tx_available
+
+    def _notify_txs_available(self) -> None:
+        if not self.txs:
+            raise RuntimeError("notified txs available but mempool is empty")
+        if self._tx_available is not None and not self.notified_txs_available:
+            self.notified_txs_available = True
+            self._tx_available.set()
+
+    # -- ingress -----------------------------------------------------------
+    async def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+        """CheckTx (clist_mempool.go:213): cache-dedup, app CheckTx, add.
+        Raises on structural rejection; returns the app response (which may
+        itself carry a non-OK code)."""
+        if len(tx) > self.max_tx_bytes:
+            raise MempoolError(f"tx too large: {len(tx)} > {self.max_tx_bytes}")
+        if len(self.txs) >= self.size_limit or self.txs_bytes + len(tx) > self.max_txs_bytes:
+            raise MempoolFullError(len(self.txs), self.txs_bytes)
+        if self.pre_check is not None:
+            err = self.pre_check(tx)
+            if err:
+                raise MempoolError(f"pre-check failed: {err}")
+        if not self.cache.push(tx):
+            # record the new sender for an existing tx (clist_mempool.go:239)
+            existing = self.txs.get(tx_hash(tx))
+            if existing is not None and sender:
+                existing.senders.add(sender)
+            raise TxInCacheError()
+
+        res = await self.proxy_app.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CheckTxType.NEW))
+        if res.code == abci.CODE_TYPE_OK:
+            mtx = MempoolTx(tx=tx, height=self.height, gas_wanted=res.gas_wanted, senders=set())
+            if sender:
+                mtx.senders.add(sender)
+            self.txs[tx_hash(tx)] = mtx
+            self.txs_bytes += len(tx)
+            self.log.debug("added good transaction", tx=tx_hash(tx).hex()[:16], res=res.code)
+            self._notify_txs_available()
+        else:
+            if not self.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)
+            self.log.debug("rejected bad transaction", tx=tx_hash(tx).hex()[:16], code=res.code)
+        return res
+
+    # -- egress ------------------------------------------------------------
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        """clist_mempool.go:471."""
+        total_bytes = 0
+        total_gas = 0
+        out = []
+        for mtx in self.txs.values():
+            nb = total_bytes + len(mtx.tx) + 8  # conservative framing overhead
+            if max_bytes > -1 and nb > max_bytes:
+                break
+            ng = total_gas + mtx.gas_wanted
+            if max_gas > -1 and ng > max_gas:
+                break
+            total_bytes = nb
+            total_gas = ng
+            out.append(mtx.tx)
+        return out
+
+    def reap_max_txs(self, n: int) -> List[bytes]:
+        txs = [m.tx for m in self.txs.values()]
+        return txs if n < 0 else txs[:n]
+
+    def size(self) -> int:
+        return len(self.txs)
+
+    def is_empty(self) -> bool:
+        return not self.txs
+
+    # -- post-commit update ------------------------------------------------
+    async def update(
+        self,
+        height: int,
+        committed_txs: List[bytes],
+        deliver_tx_responses: List[abci.ResponseDeliverTx],
+        pre_check=None,
+        post_check=None,
+    ) -> None:
+        """clist_mempool.go:529 — caller holds lock().  Removes committed
+        txs, rechecks the remainder against the post-commit app state."""
+        self.height = height
+        self.notified_txs_available = False
+        if self._tx_available is not None:
+            self._tx_available.clear()
+        if pre_check is not None:
+            self.pre_check = pre_check
+        if post_check is not None:
+            self.post_check = post_check
+
+        for tx, res in zip(committed_txs, deliver_tx_responses):
+            if res.code == abci.CODE_TYPE_OK:
+                self.cache.push(tx)  # committed: keep cached so it can't re-enter
+            elif not self.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)
+            mtx = self.txs.pop(tx_hash(tx), None)
+            if mtx is not None:
+                self.txs_bytes -= len(mtx.tx)
+
+        if self.txs:
+            if self.recheck:
+                self.log.debug("recheck txs", num_txs=len(self.txs), height=height)
+                await self._recheck_txs()
+            else:
+                self._notify_txs_available()
+
+    async def _recheck_txs(self) -> None:
+        """clist_mempool.go:591 — re-run CheckTx on survivors; drop newly
+        invalid ones."""
+        for key, mtx in list(self.txs.items()):
+            res = await self.proxy_app.check_tx(
+                abci.RequestCheckTx(tx=mtx.tx, type=abci.CheckTxType.RECHECK)
+            )
+            if res.code != abci.CODE_TYPE_OK:
+                self.txs.pop(key, None)
+                self.txs_bytes -= len(mtx.tx)
+                if not self.keep_invalid_txs_in_cache:
+                    self.cache.remove(mtx.tx)
+        if self.txs:
+            self._notify_txs_available()
+
+    async def flush(self) -> None:
+        """Remove all txs + reset cache (clist_mempool.go Flush)."""
+        self.txs.clear()
+        self.txs_bytes = 0
+        self.cache.reset()
+
+
+class NopMempool:
+    """mock/mempool.go — for non-validating components."""
+
+    def lock(self):
+        return asyncio.Lock()
+
+    async def flush_app_conn(self):
+        pass
+
+    async def check_tx(self, tx, sender=""):
+        raise MempoolError("nop mempool")
+
+    def reap_max_bytes_max_gas(self, max_bytes, max_gas):
+        return []
+
+    def reap_max_txs(self, n):
+        return []
+
+    def size(self):
+        return 0
+
+    async def update(self, *a, **kw):
+        pass
+
+    def enable_txs_available(self):
+        pass
+
+    def txs_available(self):
+        return None

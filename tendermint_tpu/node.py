@@ -1,0 +1,147 @@
+"""Node: dependency-injection assembly of the full node.
+
+Reference parity: node/node.go (NewNode:556, DefaultNewNode:90,
+OnStart:752; createAndStartProxyAppConns:578, doHandshake:601,
+createMempool:634, NewBlockExecutor:643, createConsensusReactor:659,
+onlyValidatorIsUs:314).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from .abci import types as abci_types
+from .config import Config
+from .consensus import ConsensusState, Handshaker
+from .consensus.wal import WAL
+from .libs.kvstore import open_db
+from .libs.log import get_logger
+from .libs.service import Service
+from .mempool import Mempool
+from .proxy import AppConns, default_client_creator
+from .state import StateStore
+from .state.execution import BlockExecutor
+from .state.txindex import IndexerService, NullTxIndexer, TxIndexer
+from .store import BlockStore
+from .types import GenesisDoc
+from .types.events import EventBus
+
+
+def only_validator_is_us(state, priv_val) -> bool:
+    """node/node.go:314 — a solo validator can skip fast sync."""
+    if priv_val is None or state.validators.size() > 1:
+        return False
+    addr, _ = state.validators.get_by_index(0)
+    return addr == priv_val.get_pub_key().address()
+
+
+class Node(Service):
+    def __init__(
+        self,
+        config: Config,
+        genesis_doc: GenesisDoc,
+        priv_validator=None,
+        client_creator=None,
+        db_backend: Optional[str] = None,
+    ):
+        super().__init__("node")
+        self.config = config
+        genesis_doc.validate_and_complete()
+        self.genesis_doc = genesis_doc
+        self.priv_validator = priv_validator
+        self.log = get_logger("node")
+
+        backend = db_backend or config.base.db_backend
+        home = None if backend == "memdb" else config.home
+        self.block_store = BlockStore(open_db("blockstore", home, backend))
+        self.state_db = open_db("state", home, backend)
+        self.state_store = StateStore(self.state_db)
+
+        self.event_bus = EventBus()
+        creator = client_creator or default_client_creator(config.base.proxy_app)
+        self.proxy_app = AppConns(creator)
+
+        self.state = self.state_store.load_from_db_or_genesis(genesis_doc)
+
+        # tx indexer
+        if config.tx_index.indexer == "kv":
+            self.tx_indexer = TxIndexer(open_db("tx_index", home, backend))
+        else:
+            self.tx_indexer = NullTxIndexer()
+        self.indexer_service = IndexerService(self.tx_indexer, self.event_bus)
+
+        self.mempool: Optional[Mempool] = None
+        self.consensus: Optional[ConsensusState] = None
+        self.rpc_server = None
+
+    async def on_start(self) -> None:
+        cfg = self.config
+        await self.event_bus.start()
+        await self.indexer_service.start()
+        await self.proxy_app.start()
+
+        # handshake: sync app with block store (node/node.go:601)
+        handshaker = Handshaker(self.state_store, self.state, self.block_store, self.genesis_doc)
+        self.state = await handshaker.handshake(self.proxy_app)
+
+        # mempool (node/node.go:634)
+        self.mempool = Mempool(
+            self.proxy_app.mempool(), cfg.mempool.as_dict(), height=self.state.last_block_height
+        )
+        if cfg.consensus.wait_for_txs():
+            self.mempool.enable_txs_available()
+
+        # evidence pool
+        from .evidence import EvidencePool
+
+        home = None if cfg.base.db_backend == "memdb" else cfg.home
+        self.evidence_pool = EvidencePool(
+            open_db("evidence", home, cfg.base.db_backend), self.state_store
+        )
+
+        block_exec = BlockExecutor(
+            self.state_store,
+            self.proxy_app.consensus(),
+            self.mempool,
+            evidence_pool=self.evidence_pool,
+            event_bus=self.event_bus,
+        )
+
+        self.consensus = ConsensusState(
+            cfg.consensus,
+            self.state,
+            block_exec,
+            self.block_store,
+            self.mempool,
+            evidence_pool=self.evidence_pool,
+            event_bus=self.event_bus,
+        )
+        if self.priv_validator is not None:
+            self.consensus.set_priv_validator(self.priv_validator)
+        cfg.ensure_dirs()
+        if cfg.base.db_backend != "memdb":
+            self.consensus.wal = WAL(cfg.wal_file())
+
+        # RPC (node/node.go:766)
+        if cfg.rpc.laddr:
+            from .rpc.server import RPCServer
+
+            self.rpc_server = RPCServer(self, cfg.rpc)
+            await self.rpc_server.start()
+
+        await self.consensus.start()
+        self.log.info(
+            "node started",
+            chain_id=self.genesis_doc.chain_id,
+            height=self.state.last_block_height,
+        )
+
+    async def on_stop(self) -> None:
+        if self.consensus is not None:
+            await self.consensus.stop()
+        if self.rpc_server is not None:
+            await self.rpc_server.stop()
+        await self.indexer_service.stop()
+        await self.event_bus.stop()
+        await self.proxy_app.stop()

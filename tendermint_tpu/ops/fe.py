@@ -93,8 +93,16 @@ def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
 
 
 def canonical(x: jnp.ndarray) -> jnp.ndarray:
-    """Full reduction to [0, p): conditionally subtract p twice with
-    branch-free borrow propagation (input limbs already in [0, 2^17))."""
+    """Full reduction to [0, p) with strictly normalized limbs.
+
+    carry()'s final ·19 fold can leave limb 0 slightly above 2^17 while the
+    value is already < p; the conditional subtract below would then keep the
+    unnormalized limbs and limb-wise comparison against reduced encodings
+    would wrongly fail (a ~2^-20-rare consensus-fork hazard).  Re-carrying
+    first guarantees limbs in [0, 2^17): the inputs here are near-reduced,
+    so round 1 propagates the excess with a zero top carry and round 2 is a
+    no-op."""
+    x = carry(x, rounds=2)
     for _ in range(2):
         borrow = jnp.zeros(x.shape[:-1], dtype=jnp.int64)
         out = []

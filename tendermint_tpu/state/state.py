@@ -73,15 +73,19 @@ class State:
         evidence: list,
         proposer_address: bytes,
     ) -> Block:
-        """Build a proposal block from this state (state/state.go:131)."""
-        import time as _time
-
+        """Build a proposal block from this state (state/state.go:131).
+        Block time is BFT time: genesis time at height 1, else the
+        power-weighted median of the last commit's vote timestamps."""
+        if height == 1:
+            time_ns = self.last_block_time_ns
+        else:
+            time_ns = median_time(commit, self.last_validators)
         header = Header(
             version_block=self.version_block,
             version_app=self.version_app,
             chain_id=self.chain_id,
             height=height,
-            time_ns=_time.time_ns() if height > 1 else self.last_block_time_ns or _time.time_ns(),
+            time_ns=time_ns,
             last_block_id=self.last_block_id,
             validators_hash=self.validators.hash(),
             next_validators_hash=self.next_validators.hash(),
@@ -138,6 +142,32 @@ class State:
 
 
 codec.register("tm/State")(State)
+
+
+def median_time(commit: Commit, validators: ValidatorSet) -> int:
+    """Power-weighted median of commit timestamps (state/state.go:166
+    MedianTime; BFT-time spec).  Deterministic across nodes."""
+    weighted = []
+    total_power = 0
+    for cs in commit.signatures:
+        if cs.is_absent():
+            continue
+        _, val = validators.get_by_address(cs.validator_address)
+        if val is not None:
+            total_power += val.voting_power
+            weighted.append((cs.timestamp_ns, val.voting_power))
+    if total_power == 0:
+        # no commit signature resolved in the validator set — an impossible
+        # state for a valid commit; fail loudly rather than emit time 0
+        raise ValueError("median_time: no commit signatures match the validator set")
+    weighted.sort()
+    median = total_power // 2
+    acc = 0
+    for ts, power in weighted:
+        if acc + power > median:
+            return ts
+        acc += power
+    raise AssertionError("unreachable: weighted median not found")
 
 
 def make_genesis_state(gen_doc: GenesisDoc) -> State:

@@ -8,6 +8,7 @@ powering tx_search).
 
 from __future__ import annotations
 
+import asyncio
 from typing import Dict, List, Optional
 
 from ..encoding import codec
@@ -157,3 +158,7 @@ class IndexerService(Service):
         await self.event_bus.unsubscribe_all(self.SUBSCRIBER)
         if self._task:
             self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass

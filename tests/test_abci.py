@@ -1,0 +1,180 @@
+"""ABCI tests: types round-trip, local + socket clients, example apps,
+proxy connections.
+
+Coverage model: abci/example/example_test.go (socket round-trip),
+abci/example/kvstore/kvstore_test.go, counter semantics.
+"""
+
+import asyncio
+import base64
+
+import pytest
+
+from tendermint_tpu.abci import (
+    LocalClient,
+    RequestCheckTx,
+    RequestDeliverTx,
+    RequestEcho,
+    RequestEndBlock,
+    RequestInfo,
+    RequestInitChain,
+    RequestQuery,
+    RequestSetOption,
+    SocketClient,
+    SocketServer,
+    ValidatorUpdate,
+)
+from tendermint_tpu.abci.examples import CounterApplication, KVStoreApplication
+from tendermint_tpu.abci.types import RequestCommit, decode_msg, encode_msg
+from tendermint_tpu.libs.kvstore import MemDB
+from tendermint_tpu.proxy import AppConns, default_client_creator
+
+
+class TestWireTypes:
+    def test_roundtrip(self):
+        req = RequestCheckTx(tx=b"hello", type=1)
+        d = encode_msg("check_tx", req)
+        kind, decoded = decode_msg(dict(d), direction=0)
+        assert kind == "check_tx" and decoded == req
+
+    def test_nested_validator_updates(self):
+        from tendermint_tpu.abci.types import ResponseEndBlock
+
+        resp = ResponseEndBlock(validator_updates=[ValidatorUpdate("ed25519", b"\x01" * 32, 5)])
+        d = encode_msg("end_block", resp)
+        _, decoded = decode_msg(dict(d), direction=1)
+        assert decoded.validator_updates[0].pub_key == b"\x01" * 32
+        assert decoded.validator_updates[0].power == 5
+
+
+class TestKVStoreApp:
+    def test_deliver_and_query(self):
+        app = KVStoreApplication()
+        r = app.deliver_tx(RequestDeliverTx(tx=b"name=satoshi"))
+        assert r.is_ok
+        q = app.query(RequestQuery(data=b"name"))
+        assert q.value == b"satoshi"
+        missing = app.query(RequestQuery(data=b"nobody"))
+        assert missing.value == b""
+        c = app.commit()
+        assert len(c.data) == 32
+        info = app.info(RequestInfo())
+        assert info.last_block_height == 1
+        assert info.last_block_app_hash == c.data
+
+    def test_state_persists_across_restart(self):
+        db = MemDB()
+        app = KVStoreApplication(db)
+        app.deliver_tx(RequestDeliverTx(tx=b"k=v"))
+        h = app.commit().data
+        app2 = KVStoreApplication(db)
+        assert app2.height == 1
+        assert app2.app_hash == h
+        assert app2.query(RequestQuery(data=b"k")).value == b"v"
+
+    def test_validator_updates(self):
+        app = KVStoreApplication()
+        pk = b"\x02" * 32
+        from tendermint_tpu.abci.types import RequestBeginBlock
+
+        tx = b"val:" + base64.b64encode(pk) + b"!10"
+        assert app.check_tx(RequestCheckTx(tx=tx)).is_ok
+        app.begin_block(RequestBeginBlock())
+        assert app.deliver_tx(RequestDeliverTx(tx=tx)).is_ok
+        eb = app.end_block(RequestEndBlock(height=1))
+        assert len(eb.validator_updates) == 1
+        assert eb.validator_updates[0].power == 10
+        # removal
+        app.begin_block(RequestBeginBlock())
+        app.deliver_tx(RequestDeliverTx(tx=b"val:" + base64.b64encode(pk) + b"!0"))
+        assert app.validators.get(pk) is None
+
+    def test_bad_validator_tx_rejected(self):
+        app = KVStoreApplication()
+        assert app.check_tx(RequestCheckTx(tx=b"val:garbage")).code != 0
+
+
+class TestCounterApp:
+    def test_serial_nonces(self):
+        app = CounterApplication(serial=True)
+        assert app.deliver_tx(RequestDeliverTx(tx=(0).to_bytes(8, "big"))).is_ok
+        assert app.deliver_tx(RequestDeliverTx(tx=(1).to_bytes(8, "big"))).is_ok
+        bad = app.deliver_tx(RequestDeliverTx(tx=(5).to_bytes(8, "big")))
+        assert bad.code == 2
+        app.commit()
+        assert app.check_tx(RequestCheckTx(tx=(1).to_bytes(8, "big"))).code == 2
+        assert app.check_tx(RequestCheckTx(tx=(2).to_bytes(8, "big"))).is_ok
+
+    def test_set_option(self):
+        app = CounterApplication(serial=False)
+        app.set_option(RequestSetOption(key="serial", value="on"))
+        assert app.serial
+
+
+class TestLocalClient:
+    async def test_calls(self):
+        app = KVStoreApplication()
+        client = LocalClient(app)
+        await client.start()
+        echo = await client.echo("hi")
+        assert echo.message == "hi"
+        r = await client.deliver_tx(RequestDeliverTx(tx=b"a=b"))
+        assert r.is_ok
+        c = await client.commit()
+        assert len(c.data) == 32
+        await client.stop()
+
+
+class TestSocketClientServer:
+    async def test_roundtrip_over_socket(self, tmp_path):
+        sock = f"unix://{tmp_path}/abci.sock"
+        app = KVStoreApplication()
+        server = SocketServer(sock, app)
+        await server.start()
+        try:
+            client = SocketClient(sock)
+            await client.start()
+            try:
+                echo = await client.echo("ping")
+                assert echo.message == "ping"
+                info = await client.info(RequestInfo(version="x"))
+                assert info.last_block_height == 0
+                await client.init_chain(
+                    RequestInitChain(
+                        chain_id="c", validators=[ValidatorUpdate("ed25519", b"\x03" * 32, 7)]
+                    )
+                )
+                assert app.validators[b"\x03" * 32] == 7
+                r = await client.deliver_tx(RequestDeliverTx(tx=b"x=y"))
+                assert r.is_ok
+                # pipelined requests keep FIFO order
+                results = await asyncio.gather(
+                    *(client.deliver_tx(RequestDeliverTx(tx=b"k%d=v" % i)) for i in range(20))
+                )
+                assert all(r.is_ok for r in results)
+                q = await client.query(RequestQuery(data=b"k7"))
+                assert q.value == b"v"
+                await client.flush()
+                await client.stop()
+            finally:
+                if client.is_running:
+                    await client.stop()
+        finally:
+            await server.stop()
+
+
+class TestAppConns:
+    async def test_three_connections(self):
+        conns = AppConns(default_client_creator("kvstore"))
+        await conns.start()
+        try:
+            info = await conns.query().info(RequestInfo())
+            assert info.last_block_height == 0
+            r = await conns.mempool().check_tx(RequestCheckTx(tx=b"a=1"))
+            assert r.is_ok
+            d = await conns.consensus().deliver_tx(RequestDeliverTx(tx=b"a=1"))
+            assert d.is_ok
+            c = await conns.consensus().commit()
+            assert len(c.data) == 32
+        finally:
+            await conns.stop()
