@@ -1,0 +1,28 @@
+"""P2P networking: authenticated encrypted multiplexed peer connections.
+
+Counterpart of the reference `p2p/` tree (SURVEY.md §2.3): Switch, Peer,
+MultiplexTransport, SecretConnection, MConnection, NodeInfo/NodeKey, PEX +
+address book, in-memory test helpers.
+"""
+
+from .key import NodeKey, node_id_from_pubkey
+from .node_info import NodeInfo
+from .conn.secret_connection import SecretConnection
+from .conn.connection import ChannelDescriptor, MConnection
+from .base_reactor import Reactor
+from .peer import Peer
+from .transport import Transport
+from .switch import Switch
+
+__all__ = [
+    "ChannelDescriptor",
+    "MConnection",
+    "NodeInfo",
+    "NodeKey",
+    "Peer",
+    "Reactor",
+    "SecretConnection",
+    "Switch",
+    "Transport",
+    "node_id_from_pubkey",
+]

@@ -1,0 +1,66 @@
+"""NodeInfo: identity + capability advertisement exchanged at handshake.
+
+Reference parity: p2p/node_info.go (DefaultNodeInfo:85,
+CompatibleWith:171 — same block protocol, same network, at least one
+common channel).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+from ..version import BLOCK_PROTOCOL, P2P_PROTOCOL, SOFTWARE_VERSION
+
+MAX_NUM_CHANNELS = 16
+
+
+@dataclass
+class NodeInfo:
+    node_id: str = ""
+    listen_addr: str = ""
+    network: str = ""  # chain id
+    software_version: str = SOFTWARE_VERSION
+    p2p_version: int = P2P_PROTOCOL
+    block_version: int = BLOCK_PROTOCOL
+    channels: bytes = b""
+    moniker: str = "node"
+    tx_index: str = "on"
+    rpc_address: str = ""
+
+    def validate_basic(self) -> None:
+        if not self.node_id:
+            raise ValueError("empty node id")
+        if len(self.channels) > MAX_NUM_CHANNELS:
+            raise ValueError(f"too many channels: {len(self.channels)}")
+        if len(set(self.channels)) != len(self.channels):
+            raise ValueError("duplicate channel ids")
+
+    def compatible_with(self, other: "NodeInfo") -> None:
+        """node_info.go:171 — raises on incompatibility."""
+        if self.block_version != other.block_version:
+            raise ValueError(
+                f"peer has different block version: {other.block_version} vs {self.block_version}"
+            )
+        if self.network != other.network:
+            raise ValueError(f"peer is on another network: {other.network} vs {self.network}")
+        if not set(self.channels) & set(other.channels):
+            raise ValueError("no common channels with peer")
+
+    def to_dict(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "listen_addr": self.listen_addr,
+            "network": self.network,
+            "software_version": self.software_version,
+            "p2p_version": self.p2p_version,
+            "block_version": self.block_version,
+            "channels": self.channels,
+            "moniker": self.moniker,
+            "tx_index": self.tx_index,
+            "rpc_address": self.rpc_address,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NodeInfo":
+        return cls(**d)

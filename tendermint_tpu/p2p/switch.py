@@ -1,0 +1,201 @@
+"""Switch: the peer-lifecycle hub owning reactors and connections.
+
+Reference parity: p2p/switch.go (Switch:69, AddReactor:158, OnStart:224,
+Broadcast:262, StopPeerForError:323, reconnectToPeer:376 with exponential
+backoff, persistent/unconditional peer policies).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Dict, List, Optional
+
+from ..libs.log import get_logger
+from ..libs.service import Service
+from .base_reactor import Reactor
+from .conn.connection import ChannelDescriptor
+from .node_info import NodeInfo
+from .peer import Peer
+from .transport import Transport, parse_peer_addr
+
+RECONNECT_ATTEMPTS = 20
+RECONNECT_BASE_INTERVAL = 3.0
+
+
+class SwitchError(Exception):
+    pass
+
+
+class Switch(Service):
+    def __init__(self, transport: Transport, max_inbound: int = 40, max_outbound: int = 10):
+        super().__init__("p2p-switch")
+        self.transport = transport
+        self.reactors: Dict[str, Reactor] = {}
+        self.reactors_by_ch: Dict[int, Reactor] = {}
+        self.channel_descs: List[ChannelDescriptor] = []
+        self.peers: Dict[str, Peer] = {}
+        self.persistent_addrs: Dict[str, str] = {}  # id -> addr
+        self.max_inbound = max_inbound
+        self.max_outbound = max_outbound
+        self.log = get_logger("p2p")
+        self.addr_book = None
+        self._reconnecting: set = set()
+
+    # -- reactor registry (switch.go:158) ----------------------------------
+    def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
+        for desc in reactor.get_channels():
+            if desc.id in self.reactors_by_ch:
+                raise SwitchError(f"channel {desc.id:#x} already registered")
+            self.reactors_by_ch[desc.id] = reactor
+            self.channel_descs.append(desc)
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+        self.transport.node_info.channels = bytes(d.id for d in self.channel_descs)
+        return reactor
+
+    def reactor(self, name: str) -> Optional[Reactor]:
+        return self.reactors.get(name)
+
+    @property
+    def node_info(self) -> NodeInfo:
+        return self.transport.node_info
+
+    @property
+    def node_id(self) -> str:
+        return self.transport.node_info.node_id
+
+    # -- lifecycle ---------------------------------------------------------
+    async def on_start(self) -> None:
+        for reactor in self.reactors.values():
+            await reactor.start()
+        self.spawn(self._accept_routine(), "accept")
+
+    async def on_stop(self) -> None:
+        self.transport.close()
+        for peer in list(self.peers.values()):
+            await self._stop_and_remove_peer(peer, "switch stopping")
+        for reactor in self.reactors.values():
+            if reactor.is_running:
+                await reactor.stop()
+
+    # -- inbound -----------------------------------------------------------
+    async def _accept_routine(self) -> None:
+        while True:
+            conn, ni = await self.transport.accept()
+            n_inbound = sum(1 for p in self.peers.values() if not p.outbound)
+            if n_inbound >= self.max_inbound:
+                self.log.info("rejecting inbound: full", peer=ni.node_id[:12])
+                conn.close()
+                continue
+            await self._add_peer_conn(conn, ni, outbound=False)
+
+    # -- outbound ----------------------------------------------------------
+    async def dial_peer(self, addr: str, persistent: bool = False) -> Optional[Peer]:
+        """Dial 'id@host:port'."""
+        pid, hostport = parse_peer_addr(addr)
+        if pid and pid in self.peers:
+            return self.peers[pid]
+        if persistent and pid:
+            self.persistent_addrs[pid] = addr
+        try:
+            conn, ni = await self.transport.dial(hostport, expected_id=pid)
+        except Exception as e:
+            self.log.info("dial failed", addr=addr, err=str(e))
+            if persistent and pid:
+                self._maybe_reconnect(pid)
+            return None
+        return await self._add_peer_conn(conn, ni, outbound=True, persistent=persistent, addr=addr)
+
+    async def dial_peers_async(self, addrs: List[str], persistent: bool = True) -> None:
+        for addr in addrs:
+            if addr:
+                self.spawn(self.dial_peer(addr, persistent=persistent), f"dial-{addr[:16]}")
+
+    async def _add_peer_conn(
+        self, conn, ni: NodeInfo, outbound: bool, persistent: bool = False, addr: str = ""
+    ) -> Optional[Peer]:
+        if ni.node_id in self.peers:
+            conn.close()
+            return self.peers[ni.node_id]
+        peer = Peer(
+            conn,
+            ni,
+            self.channel_descs,
+            on_receive=self._on_peer_receive,
+            on_error=self._on_peer_error,
+            outbound=outbound,
+            persistent=persistent or ni.node_id in self.persistent_addrs,
+            socket_addr=addr,
+        )
+        for reactor in self.reactors.values():
+            await reactor.init_peer(peer)
+        await peer.start()
+        self.peers[ni.node_id] = peer
+        for reactor in self.reactors.values():
+            await reactor.add_peer(peer)
+        self.log.info("added peer", peer=ni.node_id[:12], outbound=outbound, total=len(self.peers))
+        return peer
+
+    # -- demux + errors ----------------------------------------------------
+    async def _on_peer_receive(self, chan_id: int, peer: Peer, msg: bytes) -> None:
+        reactor = self.reactors_by_ch.get(chan_id)
+        if reactor is None:
+            await self.stop_peer_for_error(peer, f"unknown channel {chan_id:#x}")
+            return
+        await reactor.receive(chan_id, peer, msg)
+
+    async def _on_peer_error(self, peer: Peer, err: Exception) -> None:
+        await self.stop_peer_for_error(peer, str(err))
+
+    async def stop_peer_for_error(self, peer: Peer, reason: str) -> None:
+        """switch.go:323 + persistent reconnect :376."""
+        if peer.id not in self.peers:
+            return
+        self.log.info("stopping peer for error", peer=peer.id[:12], err=reason)
+        await self._stop_and_remove_peer(peer, reason)
+        if peer.persistent:
+            self._maybe_reconnect(peer.id)
+
+    async def stop_peer_gracefully(self, peer: Peer) -> None:
+        await self._stop_and_remove_peer(peer, None)
+
+    async def _stop_and_remove_peer(self, peer: Peer, reason: Optional[str]) -> None:
+        self.peers.pop(peer.id, None)
+        if peer.is_running:
+            await peer.stop()
+        for reactor in self.reactors.values():
+            await reactor.remove_peer(peer, reason)
+
+    def _maybe_reconnect(self, peer_id: str) -> None:
+        addr = self.persistent_addrs.get(peer_id)
+        if addr is None or peer_id in self._reconnecting:
+            return
+        self._reconnecting.add(peer_id)
+        self.spawn(self._reconnect_routine(peer_id, addr), f"reconnect-{peer_id[:8]}")
+
+    async def _reconnect_routine(self, peer_id: str, addr: str) -> None:
+        """Exponential backoff with jitter (switch.go:376)."""
+        try:
+            for attempt in range(RECONNECT_ATTEMPTS):
+                backoff = RECONNECT_BASE_INTERVAL * (1.3**attempt) * (0.8 + 0.4 * random.random())
+                await asyncio.sleep(min(backoff, 60.0))
+                if peer_id in self.peers or not self.is_running:
+                    return
+                peer = await self.dial_peer(addr, persistent=True)
+                if peer is not None:
+                    return
+        finally:
+            self._reconnecting.discard(peer_id)
+
+    # -- broadcast (switch.go:262) ----------------------------------------
+    async def broadcast(self, chan_id: int, msg: bytes) -> None:
+        await asyncio.gather(
+            *(p.send(chan_id, msg) for p in list(self.peers.values())), return_exceptions=True
+        )
+
+    def num_peers(self) -> int:
+        return len(self.peers)
+
+    def peer_list(self) -> List[Peer]:
+        return list(self.peers.values())

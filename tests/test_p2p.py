@@ -1,0 +1,208 @@
+"""P2P tests: secret connection, mconnection multiplexing, transport
+handshake, switch lifecycle + broadcast.
+
+Coverage model: p2p/conn/secret_connection_test.go,
+p2p/conn/connection_test.go, p2p/transport_test.go, p2p/switch_test.go.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.p2p import (
+    ChannelDescriptor,
+    NodeInfo,
+    NodeKey,
+    Reactor,
+    SecretConnection,
+    Switch,
+    Transport,
+)
+from tendermint_tpu.p2p.conn.secret_connection import SecretConnectionError
+from tendermint_tpu.p2p.test_util import (
+    connect_switches,
+    make_connected_switches,
+    make_switch,
+    start_switch,
+    stop_switches,
+)
+
+
+async def tcp_pair():
+    """Two connected (reader, writer) pairs over localhost."""
+    accepted = asyncio.Queue()
+
+    async def on_conn(r, w):
+        await accepted.put((r, w))
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    host, port = server.sockets[0].getsockname()[:2]
+    client = await asyncio.open_connection(host, port)
+    server_side = await accepted.get()
+    server.close()
+    return client, server_side
+
+
+async def make_secret_pair():
+    (cr, cw), (sr, sw) = await tcp_pair()
+    k1, k2 = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+    c1, c2 = await asyncio.gather(
+        SecretConnection.make(cr, cw, k1), SecretConnection.make(sr, sw, k2)
+    )
+    return (c1, k1), (c2, k2)
+
+
+class TestSecretConnection:
+    async def test_handshake_and_roundtrip(self):
+        (c1, k1), (c2, k2) = await make_secret_pair()
+        # each side learned the other's identity key
+        assert c1.remote_pubkey.bytes() == k2.pub_key().bytes()
+        assert c2.remote_pubkey.bytes() == k1.pub_key().bytes()
+        await c1.write_msg(b"hello across the wire")
+        assert await c2.read_msg() == b"hello across the wire"
+        # large message spanning many frames
+        big = bytes(range(256)) * 300
+        await c2.write_msg(big)
+        assert await c1.read_msg() == big
+        c1.close()
+        c2.close()
+
+    async def test_ciphertext_not_plaintext(self):
+        # frames on the raw socket must not contain the plaintext
+        (cr, cw), (sr, sw) = await tcp_pair()
+        k1, k2 = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+        c1, c2 = await asyncio.gather(
+            SecretConnection.make(cr, cw, k1), SecretConnection.make(sr, sw, k2)
+        )
+        secret = b"TOP-SECRET-PAYLOAD-1234567890"
+        await c1.write_msg(secret)
+        raw = await sr.readexactly(1024 + 16)
+        assert secret not in raw
+        c1.close()
+        c2.close()
+
+
+class EchoReactor(Reactor):
+    CH = 0x77
+
+    def __init__(self):
+        super().__init__("echo")
+        self.received = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=self.CH, priority=1, send_queue_capacity=10)]
+
+    async def receive(self, chan_id, peer, msg):
+        self.received.append((peer.id, bytes(msg)))
+
+
+class TestSwitch:
+    async def test_two_switches_exchange(self):
+        r1, r2 = EchoReactor(), EchoReactor()
+        sw1, sw2 = make_switch(), make_switch()
+        sw1.add_reactor("echo", r1)
+        sw2.add_reactor("echo", r2)
+        await start_switch(sw1)
+        await start_switch(sw2)
+        try:
+            await connect_switches(sw1, sw2)
+            peer = sw1.peers[sw2.node_id]
+            await peer.send(EchoReactor.CH, b"ping-1")
+            await sw2.peers[sw1.node_id].send(EchoReactor.CH, b"pong-1")
+            for _ in range(100):
+                if r1.received and r2.received:
+                    break
+                await asyncio.sleep(0.01)
+            assert r2.received == [(sw1.node_id, b"ping-1")]
+            assert r1.received == [(sw2.node_id, b"pong-1")]
+        finally:
+            await stop_switches([sw1, sw2])
+
+    async def test_large_message_multiplexed(self):
+        r1, r2 = EchoReactor(), EchoReactor()
+        sw1, sw2 = make_switch(), make_switch()
+        sw1.add_reactor("echo", r1)
+        sw2.add_reactor("echo", r2)
+        await start_switch(sw1)
+        await start_switch(sw2)
+        try:
+            await connect_switches(sw1, sw2)
+            big = b"\xab" * 100_000  # spans ~100 packets
+            await sw1.peers[sw2.node_id].send(EchoReactor.CH, big)
+            for _ in range(300):
+                if r2.received:
+                    break
+                await asyncio.sleep(0.01)
+            assert r2.received[0][1] == big
+        finally:
+            await stop_switches([sw1, sw2])
+
+    async def test_broadcast_mesh(self):
+        reactors = {}
+
+        def init(i, sw):
+            reactors[i] = EchoReactor()
+            sw.add_reactor("echo", reactors[i])
+
+        switches = await make_connected_switches(4, init)
+        try:
+            assert all(sw.num_peers() == 3 for sw in switches)
+            await switches[0].broadcast(EchoReactor.CH, b"to-all")
+            for _ in range(100):
+                if all(reactors[i].received for i in (1, 2, 3)):
+                    break
+                await asyncio.sleep(0.01)
+            for i in (1, 2, 3):
+                assert reactors[i].received[0][1] == b"to-all"
+            assert not reactors[0].received
+        finally:
+            await stop_switches(switches)
+
+    async def test_peer_disconnect_removes(self):
+        sw1, sw2 = make_switch(), make_switch()
+        r1 = EchoReactor()
+        sw1.add_reactor("echo", r1)
+        sw2.add_reactor("echo", EchoReactor())
+        await start_switch(sw1)
+        await start_switch(sw2)
+        try:
+            await connect_switches(sw1, sw2)
+            peer = sw1.peers[sw2.node_id]
+            await sw1.stop_peer_for_error(peer, "test kick")
+            assert sw2.node_id not in sw1.peers
+            # sw2's side notices the broken conn shortly
+            for _ in range(200):
+                if sw1.node_id not in sw2.peers:
+                    break
+                await asyncio.sleep(0.01)
+            assert sw1.node_id not in sw2.peers
+        finally:
+            await stop_switches([sw1, sw2])
+
+    async def test_network_mismatch_rejected(self):
+        sw1 = make_switch(network="chain-A")
+        sw2 = make_switch(network="chain-B")
+        sw1.add_reactor("echo", EchoReactor())
+        sw2.add_reactor("echo", EchoReactor())
+        await start_switch(sw1)
+        await start_switch(sw2)
+        try:
+            peer = await sw1.dial_peer(f"{sw2.node_id}@{sw2.transport.listen_addr}")
+            assert peer is None
+            assert sw1.num_peers() == 0
+        finally:
+            await stop_switches([sw1, sw2])
+
+    async def test_dial_wrong_id_rejected(self):
+        sw1, sw2 = make_switch(), make_switch()
+        sw1.add_reactor("echo", EchoReactor())
+        sw2.add_reactor("echo", EchoReactor())
+        await start_switch(sw1)
+        await start_switch(sw2)
+        try:
+            wrong_id = "ab" * 20
+            peer = await sw1.dial_peer(f"{wrong_id}@{sw2.transport.listen_addr}")
+            assert peer is None
+        finally:
+            await stop_switches([sw1, sw2])
