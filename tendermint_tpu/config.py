@@ -229,6 +229,7 @@ def test_config(home: str) -> Config:
         peer_query_maj23_sleep_duration=0.25,
     )
     cfg.base.fast_sync = False
+    cfg.p2p.laddr = ""  # tests opt into p2p with an explicit 127.0.0.1:0
     return cfg
 
 

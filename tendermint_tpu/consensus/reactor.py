@@ -1,0 +1,562 @@
+"""Consensus reactor: bridges the state machine to the p2p switch.
+
+Reference parity: consensus/reactor.go (channels 0x20-0x23 :24-27,
+Receive:214 demux, SwitchToConsensus:102, broadcastHasVoteMessage:422,
+gossipDataRoutine:467, gossipVotesRoutine:606, queryMaj23Routine:738,
+PeerState:915).
+
+TPU inversion #1 (SURVEY.md §7): peer votes are signature-checked BEFORE
+they enter the serialized consensus loop — each per-peer receive task
+enqueues into the shared AsyncBatchVerifier whose deadline flush coalesces
+concurrent votes from all peers into one device batch; consensus then adds
+them with verify=False.  Trickling votes at 10k validators become a few
+vmapped kernel calls per round instead of 10k serial host verifies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Dict, List, Optional
+
+from ..encoding import codec
+from ..libs.bitarray import BitArray
+from ..libs.log import get_logger
+from ..p2p import ChannelDescriptor, Reactor
+from ..types import BlockID, Proposal, Vote
+from ..types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from ..types.part_set import Part
+from .state import ConsensusState
+from .types import RoundStep
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+
+class PeerRoundState:
+    """What we know about a peer's consensus position
+    (consensus/types/peer_round_state.go + reactor.go:915 PeerState)."""
+
+    def __init__(self):
+        self.height = 0
+        self.round = -1
+        self.step = RoundStep.NEW_HEIGHT
+        self.start_time = 0.0
+        self.proposal = False
+        self.proposal_block_parts_header = None
+        self.proposal_block_parts: Optional[BitArray] = None
+        self.proposal_pol_round = -1
+        self.proposal_pol: Optional[BitArray] = None
+        self.prevotes: Dict[int, BitArray] = {}  # round -> bits
+        self.precommits: Dict[int, BitArray] = {}
+        self.last_commit_round = -1
+        self.last_commit: Optional[BitArray] = None
+
+    # -- updates from peer messages ---------------------------------------
+    def apply_new_round_step(self, msg: dict) -> None:
+        """reactor.go ApplyNewRoundStepMessage."""
+        psh, psr = self.height, self.round
+        self.height = msg["height"]
+        self.round = msg["round"]
+        self.step = msg["step"]
+        if psh != self.height or psr != self.round:
+            self.proposal = False
+            self.proposal_block_parts_header = None
+            self.proposal_block_parts = None
+            self.proposal_pol_round = -1
+            self.proposal_pol = None
+        if psh != self.height:
+            # peer's prevotes/precommits for the old height are irrelevant
+            if psh == self.height - 1 and msg.get("last_commit_round", -1) >= 0:
+                self.last_commit_round = msg["last_commit_round"]
+                self.last_commit = self.precommits.get(self.last_commit_round)
+            else:
+                self.last_commit_round = msg.get("last_commit_round", -1)
+                self.last_commit = None
+            self.prevotes = {}
+            self.precommits = {}
+
+    def apply_new_valid_block(self, msg: dict) -> None:
+        if self.height != msg["height"]:
+            return
+        if self.round != msg["round"] and not msg["is_commit"]:
+            return
+        from ..types import PartSetHeader
+
+        self.proposal_block_parts_header = PartSetHeader.from_dict(msg["block_parts_header"])
+        self.proposal_block_parts = BitArray.from_bytes(msg["block_parts"])
+
+    def set_has_proposal(self, proposal: Proposal) -> None:
+        if self.height != proposal.height or self.round != proposal.round:
+            return
+        if self.proposal:
+            return
+        self.proposal = True
+        if self.proposal_block_parts is None:
+            self.proposal_block_parts_header = proposal.block_id.parts_header
+            self.proposal_block_parts = BitArray(proposal.block_id.parts_header.total)
+        self.proposal_pol_round = proposal.pol_round
+
+    def set_has_proposal_block_part(self, height: int, round_: int, index: int) -> None:
+        if self.height != height or self.round != round_:
+            return
+        if self.proposal_block_parts is None:
+            return
+        self.proposal_block_parts.set_index(index, True)
+
+    def apply_proposal_pol(self, msg: dict) -> None:
+        if self.height != msg["height"]:
+            return
+        if self.proposal_pol_round != msg["proposal_pol_round"]:
+            return
+        self.proposal_pol = BitArray.from_bytes(msg["proposal_pol"])
+
+    def get_vote_bits(self, height: int, round_: int, vote_type: int, num_validators: int) -> Optional[BitArray]:
+        if height == self.height:
+            table = self.prevotes if vote_type == PREVOTE_TYPE else self.precommits
+            if round_ not in table:
+                table[round_] = BitArray(num_validators)
+            return table[round_]
+        if height == self.height - 1 and vote_type == PRECOMMIT_TYPE and round_ == self.last_commit_round:
+            if self.last_commit is None:
+                self.last_commit = BitArray(num_validators)
+            return self.last_commit
+        return None
+
+    def set_has_vote(self, height: int, round_: int, vote_type: int, index: int, num_validators: int = 0) -> None:
+        bits = self.get_vote_bits(height, round_, vote_type, num_validators)
+        if bits is not None and index < bits.bits:
+            bits.set_index(index, True)
+
+    def apply_vote_set_bits(self, msg: dict, our_votes: Optional[BitArray]) -> None:
+        bits = BitArray.from_bytes(msg["votes"])
+        existing = self.get_vote_bits(msg["height"], msg["round"], msg["type"], bits.bits)
+        if existing is not None:
+            if our_votes is not None:
+                # update = ours AND theirs, OR'd in (reactor.go ApplyVoteSetBitsMessage)
+                have = our_votes.and_(bits)
+                merged = existing.or_(have)
+                existing._v[: merged.bits] = merged._v[: existing.bits]
+            else:
+                table = self.prevotes if msg["type"] == PREVOTE_TYPE else self.precommits
+                if msg["height"] == self.height:
+                    table[msg["round"]] = bits
+
+
+class ConsensusReactor(Reactor):
+    def __init__(self, cs: ConsensusState, wait_sync: bool = False, async_verifier=None):
+        super().__init__("consensus-reactor")
+        self.cs = cs
+        self.wait_sync = wait_sync  # True while fast-syncing
+        self.async_verifier = async_verifier  # AsyncBatchVerifier or None
+        self.log = get_logger("cs-reactor")
+        self.peer_states: Dict[str, PeerRoundState] = {}
+        self._routines: Dict[str, list] = {}
+        cs.on_new_round_step.append(self._on_new_round_step)
+        cs.on_vote.append(self._on_own_vote_event)
+        cs.on_valid_block.append(self._on_valid_block)
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        """reactor.go:160 GetChannels — priorities mirror the reference."""
+        return [
+            ChannelDescriptor(id=STATE_CHANNEL, priority=5, send_queue_capacity=100),
+            ChannelDescriptor(id=DATA_CHANNEL, priority=10, send_queue_capacity=100),
+            ChannelDescriptor(id=VOTE_CHANNEL, priority=5, send_queue_capacity=100),
+            ChannelDescriptor(id=VOTE_SET_BITS_CHANNEL, priority=1, send_queue_capacity=2),
+        ]
+
+    async def on_start(self) -> None:
+        if not self.wait_sync:
+            await self.cs.start()
+
+    async def on_stop(self) -> None:
+        if self.cs.is_running:
+            await self.cs.stop()
+
+    async def switch_to_consensus(self, state, blocks_synced: int = 0) -> None:
+        """Fast-sync → consensus handover (reactor.go:102)."""
+        self.cs.update_to_state(state)
+        self.wait_sync = False
+        if blocks_synced > 0:
+            self.cs.do_wal_catchup = False
+        await self.cs.start()
+        await self._broadcast_new_round_step()
+
+    # -- cs event hooks (broadcast to peers) -------------------------------
+    def _on_new_round_step(self, rs) -> None:
+        self.spawn(self._broadcast_new_round_step(), "bcast-nrs")
+
+    def _on_own_vote_event(self, vote: Vote) -> None:
+        """broadcastHasVoteMessage (reactor.go:422)."""
+        msg = _enc("has_vote", {
+            "height": vote.height, "round": vote.round,
+            "vote_type": vote.type, "index": vote.validator_index,
+        })
+        self.spawn(self._broadcast(STATE_CHANNEL, msg), "bcast-hasvote")
+
+    def _on_valid_block(self, rs) -> None:
+        if rs.proposal_block_parts is None:
+            return
+        msg = _enc("new_valid_block", {
+            "height": rs.height, "round": rs.round,
+            "block_parts_header": rs.proposal_block_parts.header().to_dict(),
+            "block_parts": rs.proposal_block_parts.bit_array().to_bytes(),
+            "is_commit": rs.step == RoundStep.COMMIT,
+        })
+        self.spawn(self._broadcast(STATE_CHANNEL, msg), "bcast-validblock")
+
+    async def _broadcast(self, chan: int, msg: bytes) -> None:
+        if self.switch is not None:
+            await self.switch.broadcast(chan, msg)
+
+    async def _broadcast_new_round_step(self) -> None:
+        await self._broadcast(STATE_CHANNEL, self._new_round_step_msg())
+
+    def _new_round_step_msg(self) -> bytes:
+        rs = self.cs.rs
+        return _enc("new_round_step", {
+            "height": rs.height,
+            "round": rs.round,
+            "step": rs.step,
+            "seconds_since_start": max(0.0, time.monotonic() - rs.start_time),
+            "last_commit_round": rs.last_commit.round if rs.last_commit is not None else -1,
+        })
+
+    # -- peer lifecycle ----------------------------------------------------
+    async def add_peer(self, peer) -> None:
+        ps = PeerRoundState()
+        self.peer_states[peer.id] = ps
+        peer.set("cs_peer_state", ps)
+        await peer.send(STATE_CHANNEL, self._new_round_step_msg())
+        if not self.wait_sync:
+            self._start_gossip(peer, ps)
+
+    def _start_gossip(self, peer, ps) -> None:
+        self._routines[peer.id] = [
+            self.spawn(self._gossip_data_routine(peer, ps), f"gossip-data-{peer.id[:8]}"),
+            self.spawn(self._gossip_votes_routine(peer, ps), f"gossip-votes-{peer.id[:8]}"),
+            self.spawn(self._query_maj23_routine(peer, ps), f"maj23-{peer.id[:8]}"),
+        ]
+
+    async def remove_peer(self, peer, reason=None) -> None:
+        self.peer_states.pop(peer.id, None)
+        for task in self._routines.pop(peer.id, []):
+            task.cancel()
+
+    # -- receive demux (reactor.go:214) ------------------------------------
+    async def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            kind, msg = _dec(msg_bytes)
+        except Exception:
+            await self.switch.stop_peer_for_error(peer, "malformed consensus message")
+            return
+        ps = self.peer_states.get(peer.id)
+        if ps is None:
+            return
+
+        if chan_id == STATE_CHANNEL:
+            if kind == "new_round_step":
+                ps.apply_new_round_step(msg)
+            elif kind == "new_valid_block":
+                ps.apply_new_valid_block(msg)
+            elif kind == "has_vote":
+                ps.set_has_vote(
+                    msg["height"], msg["round"], msg["vote_type"], msg["index"],
+                    self.cs.rs.validators.size() if self.cs.rs.validators else 0,
+                )
+            elif kind == "vote_set_maj23":
+                await self._handle_vote_set_maj23(peer, msg)
+        elif self.wait_sync:
+            return  # ignore data/votes while fast-syncing (reactor.go:231)
+        elif chan_id == DATA_CHANNEL:
+            if kind == "proposal":
+                proposal = Proposal.from_dict(msg["proposal"])
+                ps.set_has_proposal(proposal)
+                await self.cs.set_proposal_input(proposal, peer.id)
+            elif kind == "proposal_pol":
+                ps.apply_proposal_pol(msg)
+            elif kind == "block_part":
+                ps.set_has_proposal_block_part(msg["height"], msg["round"], msg["part"]["index"])
+                await self.cs.add_block_part_input(
+                    msg["height"], msg["round"], Part.from_dict(msg["part"]), peer.id
+                )
+        elif chan_id == VOTE_CHANNEL:
+            if kind == "vote":
+                vote = Vote.from_dict(msg["vote"])
+                height = self.cs.rs.height
+                val_size = self.cs.rs.validators.size() if self.cs.rs.validators else 0
+                last_size = (
+                    self.cs.rs.last_validators.size() if self.cs.rs.last_validators else 0
+                )
+                ps.set_has_vote(
+                    vote.height, vote.round, vote.type, vote.validator_index,
+                    val_size if vote.height == height else last_size,
+                )
+                verified = await self._preverify_vote(vote)
+                if verified is None:
+                    return  # not verifiable against known sets; let cs drop it
+                if not verified:
+                    await self.switch.stop_peer_for_error(peer, "invalid vote signature")
+                    return
+                await self.cs.add_vote_input(vote, peer.id, verified=True)
+        elif chan_id == VOTE_SET_BITS_CHANNEL:
+            if kind == "vote_set_bits":
+                our_votes = None
+                rs = self.cs.rs
+                if rs.height == msg["height"] and rs.votes is not None:
+                    vs = (
+                        rs.votes.prevotes(msg["round"])
+                        if msg["type"] == PREVOTE_TYPE
+                        else rs.votes.precommits(msg["round"])
+                    )
+                    if vs is not None:
+                        our_votes = vs.bit_array_by_block_id(BlockID.from_dict(msg["block_id"]))
+                ps.apply_vote_set_bits(msg, our_votes)
+
+    async def _handle_vote_set_maj23(self, peer, msg: dict) -> None:
+        """reactor.go:258 — record peer claim, respond with our bits."""
+        rs = self.cs.rs
+        if rs.height != msg["height"] or rs.votes is None:
+            return
+        block_id = BlockID.from_dict(msg["block_id"])
+        try:
+            rs.votes.set_peer_maj23(msg["round"], msg["type"], peer.id, block_id)
+        except Exception as e:
+            await self.switch.stop_peer_for_error(peer, str(e))
+            return
+        vs = (
+            rs.votes.prevotes(msg["round"])
+            if msg["type"] == PREVOTE_TYPE
+            else rs.votes.precommits(msg["round"])
+        )
+        if vs is None:
+            return
+        our = vs.bit_array_by_block_id(block_id) or BitArray(vs.size())
+        await peer.send(
+            VOTE_SET_BITS_CHANNEL,
+            _enc("vote_set_bits", {
+                "height": msg["height"], "round": msg["round"], "type": msg["type"],
+                "block_id": msg["block_id"], "votes": our.to_bytes(),
+            }),
+        )
+
+    # -- vote pre-verification (the TPU batch path) ------------------------
+    async def _preverify_vote(self, vote: Vote) -> Optional[bool]:
+        """Check the signature against the pubkey our validator sets pin to
+        (validator_index, address).  None = can't resolve (height mismatch)."""
+        rs = self.cs.rs
+        if vote.height == rs.height:
+            val_set = rs.validators
+        elif vote.height + 1 == rs.height:
+            val_set = rs.last_validators
+        else:
+            return None
+        if val_set is None:
+            return None
+        addr, val = val_set.get_by_index(vote.validator_index)
+        if val is None or addr != vote.validator_address:
+            return False
+        sign_bytes = vote.sign_bytes(self.cs.sm_state.chain_id)
+        if self.async_verifier is not None:
+            try:
+                return await self.async_verifier.verify_one(
+                    val.pub_key.bytes(), sign_bytes, vote.signature
+                )
+            except Exception:
+                return False
+        return val.pub_key.verify(sign_bytes, vote.signature)
+
+    # -- gossip routines ---------------------------------------------------
+    async def _gossip_data_routine(self, peer, ps: PeerRoundState) -> None:
+        """reactor.go:467."""
+        sleep = self.cs.config.peer_gossip_sleep_duration
+        while True:
+            rs = self.cs.rs
+            # 1. send a proposal block part the peer lacks
+            if (
+                rs.proposal_block_parts is not None
+                and rs.height == ps.height
+                and ps.proposal_block_parts is not None
+            ):
+                ours = rs.proposal_block_parts.bit_array()
+                theirs = ps.proposal_block_parts
+                missing = ours.sub(theirs)
+                idx = missing.pick_random()
+                if idx is not None:
+                    part = rs.proposal_block_parts.get_part(idx)
+                    if part is not None:
+                        ok = await peer.send(DATA_CHANNEL, _enc("block_part", {
+                            "height": rs.height, "round": rs.round, "part": part.to_dict(),
+                        }))
+                        if ok:
+                            ps.set_has_proposal_block_part(ps.height, ps.round, idx)
+                        continue
+            # 2. peer is catching up: send parts of their next stored block
+            if 0 < ps.height < rs.height and ps.height >= self.cs.block_store.base():
+                if await self._gossip_catchup_block_part(peer, ps):
+                    continue
+                await asyncio.sleep(sleep)
+                continue
+            # 3. send the proposal (+POL) if the peer lacks it
+            if rs.proposal is not None and rs.height == ps.height and not ps.proposal:
+                if rs.round == ps.round:
+                    await peer.send(DATA_CHANNEL, _enc("proposal", {"proposal": rs.proposal.to_dict()}))
+                    ps.set_has_proposal(rs.proposal)
+                    if 0 <= rs.proposal.pol_round:
+                        pol = rs.votes.prevotes(rs.proposal.pol_round)
+                        if pol is not None:
+                            await peer.send(DATA_CHANNEL, _enc("proposal_pol", {
+                                "height": rs.height,
+                                "proposal_pol_round": rs.proposal.pol_round,
+                                "proposal_pol": pol.bit_array().to_bytes(),
+                            }))
+                    continue
+            await asyncio.sleep(sleep)
+
+    async def _gossip_catchup_block_part(self, peer, ps: PeerRoundState) -> bool:
+        """reactor.go:552 gossipDataForCatchup."""
+        if ps.proposal_block_parts is None:
+            # init from the stored block meta so we know the shape
+            meta = self.cs.block_store.load_block_meta(ps.height)
+            if meta is None:
+                return False
+            ps.proposal_block_parts_header = meta.block_id.parts_header
+            ps.proposal_block_parts = BitArray(meta.block_id.parts_header.total)
+        meta = self.cs.block_store.load_block_meta(ps.height)
+        if meta is None or ps.proposal_block_parts_header != meta.block_id.parts_header:
+            return False
+        full = BitArray.from_indices(
+            ps.proposal_block_parts.bits, range(ps.proposal_block_parts.bits)
+        )
+        missing = full.sub(ps.proposal_block_parts)
+        idx = missing.pick_random()
+        if idx is None:
+            return False
+        part = self.cs.block_store.load_block_part(ps.height, idx)
+        if part is None:
+            return False
+        ok = await peer.send(DATA_CHANNEL, _enc("block_part", {
+            "height": ps.height, "round": ps.round, "part": part.to_dict(),
+        }))
+        if ok:
+            ps.proposal_block_parts.set_index(idx, True)
+        return ok
+
+    async def _gossip_votes_routine(self, peer, ps: PeerRoundState) -> None:
+        """reactor.go:606."""
+        sleep = self.cs.config.peer_gossip_sleep_duration
+        while True:
+            rs = self.cs.rs
+            sent = False
+            if rs.height == ps.height:
+                sent = await self._gossip_votes_for_height(peer, ps)
+            elif rs.height == ps.height + 1 and rs.last_commit is not None:
+                sent = await self._pick_send_vote(peer, ps, rs.last_commit)
+            elif rs.height >= ps.height + 2 and ps.height >= self.cs.block_store.base():
+                commit = self.cs.block_store.load_block_commit(ps.height)
+                if commit is not None:
+                    sent = await self._send_commit_vote(peer, ps, commit)
+            if not sent:
+                await asyncio.sleep(sleep)
+
+    async def _gossip_votes_for_height(self, peer, ps: PeerRoundState) -> bool:
+        """reactor.go:668 gossipVotesForHeight ordering."""
+        rs = self.cs.rs
+        # peer in NewHeight: our last commit helps them finish their commit
+        if ps.step == RoundStep.NEW_HEIGHT and rs.last_commit is not None:
+            if await self._pick_send_vote(peer, ps, rs.last_commit):
+                return True
+        # peer needs POL prevotes
+        if ps.step <= RoundStep.PROPOSE and 0 <= ps.proposal_pol_round:
+            pol = rs.votes.prevotes(ps.proposal_pol_round)
+            if pol is not None and await self._pick_send_vote(peer, ps, pol):
+                return True
+        if ps.step <= RoundStep.PREVOTE_WAIT and 0 <= ps.round <= rs.round:
+            vs = rs.votes.prevotes(ps.round)
+            if vs is not None and await self._pick_send_vote(peer, ps, vs):
+                return True
+        if ps.step <= RoundStep.PRECOMMIT_WAIT and 0 <= ps.round <= rs.round:
+            vs = rs.votes.precommits(ps.round)
+            if vs is not None and await self._pick_send_vote(peer, ps, vs):
+                return True
+        if 0 <= ps.round <= rs.round:
+            vs = rs.votes.prevotes(ps.round)
+            if vs is not None and await self._pick_send_vote(peer, ps, vs):
+                return True
+        if 0 <= ps.proposal_pol_round:
+            pol = rs.votes.prevotes(ps.proposal_pol_round)
+            if pol is not None and await self._pick_send_vote(peer, ps, pol):
+                return True
+        return False
+
+    async def _pick_send_vote(self, peer, ps: PeerRoundState, vote_set) -> bool:
+        """PickSendVote (reactor.go:1036): random vote the peer lacks."""
+        if vote_set is None:
+            return False
+        peer_bits = ps.get_vote_bits(
+            vote_set.height, vote_set.round, vote_set.signed_msg_type, vote_set.size()
+        )
+        if peer_bits is None:
+            return False
+        ours = vote_set.bit_array()
+        missing = ours.sub(peer_bits)
+        idx = missing.pick_random()
+        if idx is None:
+            return False
+        vote = vote_set.get_by_index(idx)
+        if vote is None:
+            return False
+        ok = await peer.send(VOTE_CHANNEL, _enc("vote", {"vote": vote.to_dict()}))
+        if ok:
+            ps.set_has_vote(vote.height, vote.round, vote.type, idx, vote_set.size())
+        return ok
+
+    async def _send_commit_vote(self, peer, ps: PeerRoundState, commit) -> bool:
+        """Catchup: send a stored-commit precommit the peer lacks."""
+        peer_bits = ps.get_vote_bits(commit.height, commit.round, PRECOMMIT_TYPE, commit.size())
+        if peer_bits is None:
+            return False
+        ours = commit.bit_array()
+        missing = ours.sub(peer_bits)
+        idx = missing.pick_random()
+        if idx is None:
+            return False
+        vote = commit.get_vote(idx)
+        ok = await peer.send(VOTE_CHANNEL, _enc("vote", {"vote": vote.to_dict()}))
+        if ok:
+            ps.set_has_vote(vote.height, vote.round, vote.type, idx, commit.size())
+        return ok
+
+    async def _query_maj23_routine(self, peer, ps: PeerRoundState) -> None:
+        """reactor.go:738 — periodically tell peers about our maj23s."""
+        sleep = self.cs.config.peer_query_maj23_sleep_duration
+        while True:
+            await asyncio.sleep(sleep)
+            rs = self.cs.rs
+            if rs.votes is None or rs.height != ps.height:
+                continue
+            for vote_type, getter in (
+                (PREVOTE_TYPE, rs.votes.prevotes),
+                (PRECOMMIT_TYPE, rs.votes.precommits),
+            ):
+                vs = getter(ps.round if ps.round >= 0 else rs.round)
+                if vs is None:
+                    continue
+                maj23, ok = vs.two_thirds_majority()
+                if ok:
+                    await peer.send(STATE_CHANNEL, _enc("vote_set_maj23", {
+                        "height": rs.height, "round": vs.round, "type": vote_type,
+                        "block_id": maj23.to_dict(),
+                    }))
+
+
+def _enc(kind: str, fields: dict) -> bytes:
+    return codec.dumps({"k": kind, **fields})
+
+
+def _dec(msg_bytes: bytes):
+    d = codec.loads(msg_bytes)
+    return d.pop("k"), d

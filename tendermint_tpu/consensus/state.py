@@ -160,9 +160,12 @@ class ConsensusState(Service):
     # ------------------------------------------------------------------
     # inputs (reactor/public surface)
     # ------------------------------------------------------------------
-    async def add_vote_input(self, vote: Vote, peer_id: str = "") -> None:
+    async def add_vote_input(self, vote: Vote, peer_id: str = "", verified: bool = False) -> None:
+        """verified=True marks a signature already checked by the reactor's
+        batch-verification path (SURVEY.md §7 inversion #1) — structural
+        validation still happens in the VoteSet."""
         await self.msg_queue.put(
-            {"type": "vote", "vote": vote, "peer_id": peer_id}
+            {"type": "vote", "vote": vote, "peer_id": peer_id, "verified": verified}
         )
 
     async def set_proposal_input(self, proposal: Proposal, peer_id: str = "") -> None:
@@ -253,7 +256,7 @@ class ConsensusState(Service):
             elif kind == "block_part":
                 await self._add_proposal_block_part(mi["height"], mi["round"], mi["part"], peer_id)
             elif kind == "vote":
-                await self._try_add_vote(mi["vote"], peer_id)
+                await self._try_add_vote(mi["vote"], peer_id, mi.get("verified", False))
         except ErrVoteConflictingVotes:
             raise  # own double-sign — _try_add_vote re-raises only then; halt
         except (VoteError, PartSetError, InvalidProposalSignatureError,
@@ -747,10 +750,10 @@ class ConsensusState(Service):
     # ------------------------------------------------------------------
     # votes
     # ------------------------------------------------------------------
-    async def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+    async def _try_add_vote(self, vote: Vote, peer_id: str, verified: bool = False) -> bool:
         """state.go:1706."""
         try:
-            return await self._add_vote(vote, peer_id)
+            return await self._add_vote(vote, peer_id, verified)
         except VoteHeightMismatchError:
             return False
         except ErrVoteConflictingVotes as e:
@@ -767,7 +770,7 @@ class ConsensusState(Service):
                 self.evidence_pool.add_evidence(e.evidence)
             return False
 
-    async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+    async def _add_vote(self, vote: Vote, peer_id: str, verified: bool = False) -> bool:
         """state.go:1751."""
         rs = self.rs
 
@@ -775,7 +778,7 @@ class ConsensusState(Service):
         if vote.height + 1 == rs.height:
             if not (rs.step == RoundStep.NEW_HEIGHT and vote.type == PRECOMMIT_TYPE):
                 raise VoteHeightMismatchError("wrong height, not a LastCommit straggler")
-            added = rs.last_commit.add_vote(vote)
+            added = rs.last_commit.add_vote(vote, verify=not verified)
             if not added:
                 return False
             self.log.debug("added to lastPrecommits")
@@ -788,7 +791,7 @@ class ConsensusState(Service):
             raise VoteHeightMismatchError(f"vote height {vote.height} != {rs.height}")
 
         height = rs.height
-        added = rs.votes.add_vote(vote, peer_id)
+        added = rs.votes.add_vote(vote, peer_id, verify=not verified)
         if not added:
             return False
         await self._publish_vote(vote)

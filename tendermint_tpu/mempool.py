@@ -43,6 +43,7 @@ class MempoolTx:
     height: int  # height when validated
     gas_wanted: int
     senders: set  # peer ids that sent us this tx (mempoolIDs analogue)
+    seq: int = 0  # monotone insertion sequence (clist-iteration analogue)
 
 
 class TxCache:
@@ -89,6 +90,9 @@ class Mempool:
         self.txs: "Dict[bytes, MempoolTx]" = {}  # insertion-ordered
         self.txs_bytes = 0
         self._lock = asyncio.Lock()
+        self._seq = 0
+        self._tx_log: List[MempoolTx] = []  # append-only, ordered by seq
+        self._new_tx_event = asyncio.Event()  # wakes broadcast routines
         self._tx_available: Optional[asyncio.Event] = None
         self.notified_txs_available = False
         self.pre_check: Optional[Callable[[bytes], Optional[str]]] = None
@@ -138,11 +142,16 @@ class Mempool:
 
         res = await self.proxy_app.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CheckTxType.NEW))
         if res.code == abci.CODE_TYPE_OK:
-            mtx = MempoolTx(tx=tx, height=self.height, gas_wanted=res.gas_wanted, senders=set())
+            self._seq += 1
+            mtx = MempoolTx(
+                tx=tx, height=self.height, gas_wanted=res.gas_wanted, senders=set(), seq=self._seq
+            )
             if sender:
                 mtx.senders.add(sender)
             self.txs[tx_hash(tx)] = mtx
             self.txs_bytes += len(tx)
+            self._tx_log.append(mtx)
+            self._new_tx_event.set()
             self.log.debug("added good transaction", tx=tx_hash(tx).hex()[:16], res=res.code)
             self._notify_txs_available()
         else:
@@ -235,6 +244,25 @@ class Mempool:
         self.txs.clear()
         self.txs_bytes = 0
         self.cache.reset()
+
+    # -- broadcast-routine support (mempool/reactor.go clist walk) ---------
+    async def next_txs_after(self, seq: int) -> List[MempoolTx]:
+        """Txs with insertion seq > given, waiting for new arrivals when
+        drained — the waitable-iteration contract the reference gets from
+        libs/clist.  O(new txs) via bisect over the append-only log, not a
+        full-pool scan per wakeup per peer."""
+        import bisect
+
+        while True:
+            start = bisect.bisect_right(self._tx_log, seq, key=lambda m: m.seq)
+            out = [m for m in self._tx_log[start:] if tx_hash(m.tx) in self.txs]
+            if out:
+                return out
+            # drop consumed prefix knowledge: compact when mostly stale
+            if len(self._tx_log) > 2 * len(self.txs) + 64:
+                self._tx_log = [m for m in self._tx_log if tx_hash(m.tx) in self.txs]
+            self._new_tx_event.clear()
+            await self._new_tx_event.wait()
 
 
 class NopMempool:

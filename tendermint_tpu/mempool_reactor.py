@@ -1,0 +1,72 @@
+"""Mempool reactor: tx gossip.
+
+Reference parity: mempool/reactor.go (channel 0x30:20,
+broadcastTxRoutine:188 walking the clist per peer and skipping the
+originating sender, Receive:157 feeding CheckTx).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List
+
+from .encoding import codec
+from .libs.log import get_logger
+from .mempool import Mempool, MempoolError
+from .p2p import ChannelDescriptor, Reactor
+
+MEMPOOL_CHANNEL = 0x30
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool: Mempool, broadcast: bool = True):
+        super().__init__("mempool-reactor")
+        self.mempool = mempool
+        self.broadcast = broadcast
+        self.log = get_logger("mempool-reactor")
+        self._routines = {}
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return [ChannelDescriptor(id=MEMPOOL_CHANNEL, priority=5, send_queue_capacity=128)]
+
+    async def add_peer(self, peer) -> None:
+        if self.broadcast:
+            self._routines[peer.id] = self.spawn(
+                self._broadcast_tx_routine(peer), f"mempool-bcast-{peer.id[:8]}"
+            )
+
+    async def remove_peer(self, peer, reason=None) -> None:
+        task = self._routines.pop(peer.id, None)
+        if task is not None:
+            task.cancel()
+
+    async def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        """reactor.go:157 — peer txs into CheckTx with the sender marked."""
+        try:
+            txs = codec.loads(msg_bytes)["txs"]
+        except Exception:
+            await self.switch.stop_peer_for_error(peer, "malformed mempool message")
+            return
+        for tx in txs:
+            try:
+                await self.mempool.check_tx(tx, sender=peer.id)
+            except MempoolError:
+                pass  # duplicates/full are not peer faults
+
+    async def _broadcast_tx_routine(self, peer) -> None:
+        """reactor.go:188 — stream mempool txs to the peer, skipping txs it
+        sent us."""
+        seq = 0
+        while True:
+            mtxs = await self.mempool.next_txs_after(seq)
+            batch = []
+            for mtx in mtxs:
+                seq = max(seq, mtx.seq)
+                if peer.id in mtx.senders:
+                    continue
+                batch.append(mtx.tx)
+            if batch:
+                ok = await peer.send(MEMPOOL_CHANNEL, codec.dumps({"txs": batch}))
+                if not ok:
+                    return
+            await asyncio.sleep(0.01)

@@ -73,6 +73,9 @@ class Node(Service):
 
         self.mempool: Optional[Mempool] = None
         self.consensus: Optional[ConsensusState] = None
+        self.consensus_reactor = None
+        self.switch = None
+        self.node_key = None
         self.rpc_server = None
 
     async def on_start(self) -> None:
@@ -130,7 +133,41 @@ class Node(Service):
             self.rpc_server = RPCServer(self, cfg.rpc)
             await self.rpc_server.start()
 
-        await self.consensus.start()
+        # p2p stack + reactors (node/node.go:653-709)
+        if cfg.p2p.laddr and cfg.p2p.laddr != "none":
+            from .consensus.reactor import ConsensusReactor
+            from .evidence_reactor import EvidenceReactor
+            from .mempool_reactor import MempoolReactor
+            from .p2p import NodeInfo, NodeKey, Switch, Transport
+
+            self.node_key = NodeKey.load_or_gen(cfg.node_key_file())
+            node_info = NodeInfo(
+                node_id=self.node_key.id,
+                network=self.genesis_doc.chain_id,
+                moniker=cfg.base.moniker,
+            )
+            transport = Transport(self.node_key, node_info)
+            self.switch = Switch(
+                transport,
+                max_inbound=cfg.p2p.max_num_inbound_peers,
+                max_outbound=cfg.p2p.max_num_outbound_peers,
+            )
+            self.consensus_reactor = ConsensusReactor(self.consensus)
+            self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+            # always registered — broadcast=false only disables outbound
+            # gossip, inbound txs must still be accepted (mempool/reactor.go)
+            self.switch.add_reactor(
+                "MEMPOOL", MempoolReactor(self.mempool, broadcast=cfg.mempool.broadcast)
+            )
+            self.switch.add_reactor("EVIDENCE", EvidenceReactor(self.evidence_pool))
+            await transport.listen(cfg.p2p.laddr)
+            await self.switch.start()  # starts reactors, incl. consensus
+            if cfg.p2p.persistent_peers:
+                await self.switch.dial_peers_async(
+                    cfg.p2p.persistent_peers.split(","), persistent=True
+                )
+        else:
+            await self.consensus.start()
         self.log.info(
             "node started",
             chain_id=self.genesis_doc.chain_id,
@@ -138,7 +175,9 @@ class Node(Service):
         )
 
     async def on_stop(self) -> None:
-        if self.consensus is not None:
+        if self.switch is not None:
+            await self.switch.stop()  # stops reactors incl. consensus
+        elif self.consensus is not None:
             await self.consensus.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()

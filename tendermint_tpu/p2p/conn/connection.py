@@ -67,6 +67,10 @@ class _Channel:
 
     def recv_packet(self, packet: dict) -> Optional[bytes]:
         """Returns the full message when the eof packet arrives."""
+        if len(packet["d"]) > self.max_payload:
+            raise ConnectionError(
+                f"packet payload exceeds max on channel {self.desc.id:#x}"
+            )
         self.recv_buf += packet["d"]
         if len(self.recv_buf) > self.desc.recv_message_capacity:
             raise ConnectionError(
@@ -210,9 +214,12 @@ class MConnection(Service):
 
     # -- receiving ---------------------------------------------------------
     async def _recv_routine(self) -> None:
+        # inbound packets are capped like outbound ones — a peer must not be
+        # able to force multi-MB allocations with one oversized frame
+        max_packet = self.max_packet_payload + 1024  # payload + framing slack
         try:
             while True:
-                raw = await self.conn.read_msg()
+                raw = await self.conn.read_msg(max_size=max_packet)
                 await self._recv_limiter.consume(len(raw))
                 packet = msgpack.unpackb(raw, raw=False)
                 self._last_msg_recv = time.monotonic()

@@ -63,4 +63,9 @@ class NodeInfo:
 
     @classmethod
     def from_dict(cls, d: dict) -> "NodeInfo":
-        return cls(**d)
+        # ignore unknown fields so newer peers with extra NodeInfo fields
+        # still handshake (rolling-upgrade compatibility)
+        import dataclasses
+
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})

@@ -41,6 +41,7 @@ class Switch(Service):
         self.log = get_logger("p2p")
         self.addr_book = None
         self._reconnecting: set = set()
+        self._connecting: set = set()
 
     # -- reactor registry (switch.go:158) ----------------------------------
     def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
@@ -115,9 +116,20 @@ class Switch(Service):
     async def _add_peer_conn(
         self, conn, ni: NodeInfo, outbound: bool, persistent: bool = False, addr: str = ""
     ) -> Optional[Peer]:
-        if ni.node_id in self.peers:
+        # reserve the id synchronously — simultaneous inbound+outbound to the
+        # same peer must not both pass the check across the awaits below
+        if ni.node_id in self.peers or ni.node_id in self._connecting:
             conn.close()
-            return self.peers[ni.node_id]
+            return self.peers.get(ni.node_id)
+        self._connecting.add(ni.node_id)
+        try:
+            return await self._add_peer_conn_locked(conn, ni, outbound, persistent, addr)
+        finally:
+            self._connecting.discard(ni.node_id)
+
+    async def _add_peer_conn_locked(
+        self, conn, ni: NodeInfo, outbound: bool, persistent: bool, addr: str
+    ) -> Optional[Peer]:
         peer = Peer(
             conn,
             ni,

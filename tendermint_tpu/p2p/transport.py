@@ -64,7 +64,13 @@ class Transport:
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(host, port), DIAL_TIMEOUT
         )
-        conn, ni = await asyncio.wait_for(self._upgrade(reader, writer), self.handshake_timeout)
+        try:
+            conn, ni = await asyncio.wait_for(
+                self._upgrade(reader, writer), self.handshake_timeout
+            )
+        except Exception:
+            writer.close()  # reconnect loops must not leak sockets
+            raise
         if expected_id and ni.node_id != expected_id:
             conn.close()
             raise TransportError(f"dialed {expected_id}, got {ni.node_id}")
