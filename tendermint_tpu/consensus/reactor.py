@@ -178,6 +178,7 @@ class ConsensusReactor(Reactor):
 
     async def switch_to_consensus(self, state, blocks_synced: int = 0) -> None:
         """Fast-sync → consensus handover (reactor.go:102)."""
+        self.cs.reconstruct_last_commit_if_needed(state)
         self.cs.update_to_state(state)
         self.wait_sync = False
         if blocks_synced > 0:

@@ -778,6 +778,8 @@ class ConsensusState(Service):
         if vote.height + 1 == rs.height:
             if not (rs.step == RoundStep.NEW_HEIGHT and vote.type == PRECOMMIT_TYPE):
                 raise VoteHeightMismatchError("wrong height, not a LastCommit straggler")
+            if rs.last_commit is None:
+                raise VoteHeightMismatchError("no last commit to add straggler vote to")
             added = rs.last_commit.add_vote(vote, verify=not verified)
             if not added:
                 return False
@@ -947,6 +949,10 @@ class ConsensusState(Service):
             if pc is None or not pc.has_two_thirds_majority():
                 raise RuntimeError("update_to_state called but last precommit round lacks +2/3")
             last_precommits = pc
+        elif rs.last_commit is not None and rs.last_commit.height == state.last_block_height:
+            # keep a LastCommit reconstructed from the seen commit (fast-sync
+            # handover path) instead of clobbering it
+            last_precommits = rs.last_commit
 
         height = state.last_block_height + 1
         rs.height = height

@@ -1,0 +1,19 @@
+"""Fast sync: catch up to the chain head by downloading committed blocks.
+
+Modeled on the reference's v2 "riri-org" design (SURVEY.md §2.2:
+blockchain/v2/scheduler.go + processor.go — pure, deterministically
+testable state machines wired by a reactor that owns all IO), with the v0
+verification rule (blockchain/v0/reactor.go:216: verify block N with the
+LastCommit carried in block N+1, then ApplyBlock).
+
+TPU angle: commit verification during replay is the BASELINE config #5 hot
+loop — each height's LastCommit verifies as one batched kernel call, and
+runs of heights with an unchanged validator set verify as one combined
+batch across heights (verify_commit_run).
+"""
+
+from .scheduler import Scheduler
+from .processor import Processor
+from .reactor import BlockchainReactor, BLOCKCHAIN_CHANNEL
+
+__all__ = ["BlockchainReactor", "BLOCKCHAIN_CHANNEL", "Processor", "Scheduler"]

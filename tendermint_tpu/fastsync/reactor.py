@@ -1,0 +1,204 @@
+"""Blockchain (fast-sync) reactor: IO around the scheduler + processor.
+
+Reference parity: blockchain/v0/reactor.go (channel 0x40:20, status
+broadcast, block request/response handling, poolRoutine:216 trySync,
+SwitchToConsensus handover :276) structured the v2 way (io separated from
+the pure FSMs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from ..encoding import codec
+from ..libs.log import get_logger
+from ..p2p import ChannelDescriptor, Reactor
+from ..types import Block, BlockID
+from ..types.params import BLOCK_PART_SIZE_BYTES
+from .processor import Processor
+from .scheduler import Scheduler
+
+BLOCKCHAIN_CHANNEL = 0x40
+STATUS_BROADCAST_INTERVAL = 2.0
+TRY_SYNC_INTERVAL = 0.01
+SWITCH_TO_CONSENSUS_INTERVAL = 1.0
+
+
+class BlockchainReactor(Reactor):
+    def __init__(
+        self,
+        state,  # sm State (current)
+        block_exec,
+        block_store,
+        fast_sync: bool,
+        consensus_reactor=None,  # for the handover
+    ):
+        super().__init__("blockchain-reactor")
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.fast_sync = fast_sync
+        self.consensus_reactor = consensus_reactor
+        self.log = get_logger("fastsync")
+        start_height = max(block_store.height() + 1, state.last_block_height + 1)
+        self.scheduler = Scheduler(start_height)
+        self.processor = Processor(start_height)
+        self.blocks_synced = 0
+        self._started_at = 0.0
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=BLOCKCHAIN_CHANNEL,
+                priority=10,
+                send_queue_capacity=1000,
+                recv_message_capacity=BLOCK_PART_SIZE_BYTES * 200,
+            )
+        ]
+
+    async def on_start(self) -> None:
+        self._started_at = time.monotonic()
+        if self.fast_sync:
+            self.spawn(self._pool_routine(), "pool")
+        self.spawn(self._status_broadcast_routine(), "status-bcast")
+
+    # -- peer lifecycle ----------------------------------------------------
+    async def add_peer(self, peer) -> None:
+        await peer.send(BLOCKCHAIN_CHANNEL, _enc("status_response", {
+            "height": self.block_store.height(), "base": self.block_store.base(),
+        }))
+        if self.fast_sync:
+            self.scheduler.add_peer(peer.id)
+
+    async def remove_peer(self, peer, reason=None) -> None:
+        self.scheduler.remove_peer(peer.id)
+
+    # -- receive -----------------------------------------------------------
+    async def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            kind, msg = _dec(msg_bytes)
+        except Exception:
+            await self.switch.stop_peer_for_error(peer, "malformed blockchain message")
+            return
+        if kind == "status_request":
+            await peer.send(BLOCKCHAIN_CHANNEL, _enc("status_response", {
+                "height": self.block_store.height(), "base": self.block_store.base(),
+            }))
+        elif kind == "status_response":
+            if self.fast_sync:
+                self.scheduler.set_peer_range(peer.id, msg["base"], msg["height"])
+        elif kind == "block_request":
+            await self._serve_block(peer, msg["height"])
+        elif kind == "block_response":
+            if not self.fast_sync:
+                return
+            try:
+                block = Block.deserialize(msg["block"])
+            except Exception:
+                await self.switch.stop_peer_for_error(peer, "undecodable block response")
+                return
+            if self.scheduler.block_received(peer.id, block.height):
+                self.processor.add_block(block.height, block, peer.id)
+            else:
+                await self.switch.stop_peer_for_error(peer, "unsolicited block")
+        elif kind == "no_block_response":
+            self.scheduler.no_block(peer.id, msg["height"])
+
+    async def _serve_block(self, peer, height: int) -> None:
+        block = self.block_store.load_block(height)
+        if block is None:
+            await peer.send(BLOCKCHAIN_CHANNEL, _enc("no_block_response", {"height": height}))
+            return
+        await peer.send(BLOCKCHAIN_CHANNEL, _enc("block_response", {"block": block.serialize()}))
+
+    # -- routines ----------------------------------------------------------
+    async def _status_broadcast_routine(self) -> None:
+        while True:
+            await self.switch.broadcast(BLOCKCHAIN_CHANNEL, _enc("status_request", {}))
+            await asyncio.sleep(STATUS_BROADCAST_INTERVAL)
+
+    async def _pool_routine(self) -> None:
+        """v0 poolRoutine:216 — request scheduling + trySync + handover."""
+        last_switch_check = 0.0
+        while True:
+            now = time.monotonic()
+            # issue requests
+            for peer_id, height in self.scheduler.next_requests(now):
+                peer = self.switch.peers.get(peer_id)
+                if peer is None:
+                    self.scheduler.remove_peer(peer_id)
+                    continue
+                if peer.try_send(BLOCKCHAIN_CHANNEL, _enc("block_request", {"height": height})):
+                    self.scheduler.mark_requested(peer_id, height, now)
+
+            # apply what we can
+            await self._try_sync()
+
+            # caught up? (grace period so peers can report their status)
+            if (
+                now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL
+                and now - self._started_at > SWITCH_TO_CONSENSUS_INTERVAL
+            ):
+                last_switch_check = now
+                if self.scheduler.is_caught_up():
+                    await self._switch_to_consensus()
+                    return
+            await asyncio.sleep(TRY_SYNC_INTERVAL)
+
+    async def _try_sync(self) -> None:
+        """Verify + apply contiguous pairs (v0 reactor.go:244 trySync)."""
+        while True:
+            pair = self.processor.peek_two()
+            if pair is None:
+                return
+            first, second = pair
+            first_id = BlockID(first.hash(), first.make_part_set(BLOCK_PART_SIZE_BYTES).header())
+            try:
+                # verify first with second's LastCommit (batched over V sigs)
+                self.state.validators.verify_commit(
+                    self.state.chain_id, first_id, first.height, second.last_commit
+                )
+            except Exception as e:
+                self.log.error("invalid block in fast sync", height=first.height, err=str(e))
+                p1, p2 = self.processor.drop_invalid()
+                for pid in (p1, p2):
+                    peer = self.switch.peers.get(pid) if pid else None
+                    if peer is not None:
+                        await self.switch.stop_peer_for_error(peer, "sent invalid block")
+                    if pid:
+                        self.scheduler.remove_peer(pid)
+                return
+            self.block_store.save_block(
+                first, first.make_part_set(BLOCK_PART_SIZE_BYTES), second.last_commit
+            )
+            self.state, _ = await self.block_exec.apply_block(self.state, first_id, first)
+            self.processor.pop_processed()
+            self.scheduler.block_processed(first.height)
+            self.blocks_synced += 1
+            if self.blocks_synced % 100 == 0:
+                self.log.info("fast sync", height=self.processor.height, synced=self.blocks_synced)
+
+    async def _switch_to_consensus(self) -> None:
+        """reactor.go:276 — hand over to the consensus reactor."""
+        self.log.info(
+            "switching to consensus", height=self.state.last_block_height, synced=self.blocks_synced
+        )
+        self.fast_sync = False
+        if self.consensus_reactor is not None:
+            await self.consensus_reactor.switch_to_consensus(self.state, self.blocks_synced)
+            # late gossip routines for peers added while syncing
+            for peer in self.switch.peer_list():
+                ps = self.consensus_reactor.peer_states.get(peer.id)
+                if ps is not None and peer.id not in self.consensus_reactor._routines:
+                    self.consensus_reactor._start_gossip(peer, ps)
+
+
+def _enc(kind: str, fields: dict) -> bytes:
+    return codec.dumps({"k": kind, **fields})
+
+
+def _dec(msg_bytes: bytes):
+    d = codec.loads(msg_bytes)
+    return d.pop("k"), d

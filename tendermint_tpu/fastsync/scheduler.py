@@ -1,0 +1,156 @@
+"""Scheduler: pure peer/block-request state machine.
+
+Reference parity: blockchain/v2/scheduler.go (event-in/event-out over
+peer states and block states; per-height ownership; timeout pruning;
+termination detection) — no IO, fully table-testable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+
+@dataclass
+class PeerInfo:
+    peer_id: str
+    height: int = 0  # best height the peer claims
+    base: int = 0  # lowest height the peer retains
+    pending: Set[int] = field(default_factory=set)  # heights requested from it
+
+
+class Scheduler:
+    """Decides which heights to request from which peers.
+
+    All methods are synchronous, deterministic, and IO-free: inputs are
+    events (peer status, block receipt, processing results, time), outputs
+    are request lists / state queries.
+    """
+
+    def __init__(
+        self,
+        initial_height: int,
+        max_pending_per_peer: int = 20,
+        max_total_pending: int = 600,  # v0 pool's requester cap
+        request_timeout: float = 15.0,
+    ):
+        self.height = initial_height  # next height to schedule/process
+        self.max_pending_per_peer = max_pending_per_peer
+        self.max_total_pending = max_total_pending
+        self.request_timeout = request_timeout
+        self.peers: Dict[str, PeerInfo] = {}
+        self.pending: Dict[int, Tuple[str, float]] = {}  # height -> (peer, at)
+        self.received: Dict[int, str] = {}  # height -> peer that delivered
+
+    # -- peer events -------------------------------------------------------
+    def add_peer(self, peer_id: str) -> None:
+        if peer_id not in self.peers:
+            self.peers[peer_id] = PeerInfo(peer_id)
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        """Status response (scheduler.go setPeerRange)."""
+        self.add_peer(peer_id)
+        p = self.peers[peer_id]
+        if height < p.height:
+            return  # peers may not regress
+        p.base, p.height = base, height
+
+    def remove_peer(self, peer_id: str) -> List[int]:
+        """Returns heights that must be rescheduled."""
+        p = self.peers.pop(peer_id, None)
+        if p is None:
+            return []
+        freed = []
+        for h, (owner, _) in list(self.pending.items()):
+            if owner == peer_id:
+                del self.pending[h]
+                freed.append(h)
+        # received-but-unprocessed blocks from this peer stay usable
+        return freed
+
+    # -- block events ------------------------------------------------------
+    def block_received(self, peer_id: str, height: int) -> bool:
+        """False = unsolicited/wrong peer (punishable)."""
+        owner = self.pending.get(height)
+        if owner is None or owner[0] != peer_id:
+            return False
+        del self.pending[height]
+        self.received[height] = peer_id
+        p = self.peers.get(peer_id)
+        if p is not None:
+            p.pending.discard(height)
+        return True
+
+    def no_block(self, peer_id: str, height: int) -> None:
+        """Peer says it doesn't have the block: free the height."""
+        owner = self.pending.get(height)
+        if owner is not None and owner[0] == peer_id:
+            del self.pending[height]
+            p = self.peers.get(peer_id)
+            if p is not None:
+                p.pending.discard(height)
+
+    def block_processed(self, height: int) -> None:
+        if height != self.height:
+            raise ValueError(f"processed {height}, expected {self.height}")
+        self.received.pop(height, None)
+        self.height += 1
+
+    def block_invalid(self, height: int) -> Optional[str]:
+        """Verification failed: requeue from someone else; returns the peer
+        to punish."""
+        peer = self.received.pop(height, None)
+        if peer is not None:
+            self.remove_peer(peer)
+        return peer
+
+    # -- scheduling --------------------------------------------------------
+    def max_peer_height(self) -> int:
+        return max((p.height for p in self.peers.values()), default=0)
+
+    def next_requests(self, now: float) -> List[Tuple[str, int]]:
+        """(peer, height) pairs to request next; also re-assigns timed-out
+        pending requests."""
+        # prune timeouts
+        for h, (owner, at) in list(self.pending.items()):
+            if now - at > self.request_timeout:
+                del self.pending[h]
+                p = self.peers.get(owner)
+                if p is not None:
+                    p.pending.discard(h)
+
+        out: List[Tuple[str, int]] = []
+        target = self.max_peer_height()
+        h = self.height
+        while len(self.pending) + len(out) < self.max_total_pending and h <= target:
+            if h in self.pending or h in self.received:
+                h += 1
+                continue
+            peer = self._pick_peer_for(h)
+            if peer is None:
+                h += 1
+                continue
+            out.append((peer.peer_id, h))
+            peer.pending.add(h)
+            h += 1
+        return out
+
+    def mark_requested(self, peer_id: str, height: int, now: float) -> None:
+        self.pending[height] = (peer_id, now)
+
+    def _pick_peer_for(self, height: int) -> Optional[PeerInfo]:
+        candidates = [
+            p
+            for p in self.peers.values()
+            if p.base <= height <= p.height and len(p.pending) < self.max_pending_per_peer
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda p: len(p.pending))
+
+    def is_caught_up(self) -> bool:
+        """v0 pool.IsCaughtUp: at/above every peer's best height (with at
+        least one peer known)."""
+        if not self.peers:
+            return False
+        return self.height >= self.max_peer_height()

@@ -152,7 +152,20 @@ class Node(Service):
                 max_inbound=cfg.p2p.max_num_inbound_peers,
                 max_outbound=cfg.p2p.max_num_outbound_peers,
             )
-            self.consensus_reactor = ConsensusReactor(self.consensus)
+            from .fastsync import BlockchainReactor
+
+            do_fast_sync = cfg.base.fast_sync and not only_validator_is_us(
+                self.state, self.priv_validator
+            )
+            self.consensus_reactor = ConsensusReactor(self.consensus, wait_sync=do_fast_sync)
+            self.blockchain_reactor = BlockchainReactor(
+                self.state,
+                block_exec,
+                self.block_store,
+                fast_sync=do_fast_sync,
+                consensus_reactor=self.consensus_reactor,
+            )
+            self.switch.add_reactor("BLOCKCHAIN", self.blockchain_reactor)
             self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
             # always registered — broadcast=false only disables outbound
             # gossip, inbound txs must still be accepted (mempool/reactor.go)

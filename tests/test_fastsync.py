@@ -1,0 +1,175 @@
+"""Fast-sync tests: deterministic scheduler/processor FSMs (the v2-style
+table-testable tier, SURVEY.md §4 tier 5) + a real network catch-up.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.config import test_config as make_test_cfg
+from tendermint_tpu.fastsync.processor import Processor, verify_commit_run
+from tendermint_tpu.fastsync.scheduler import Scheduler
+from tendermint_tpu.node import Node
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
+
+from tests.test_consensus_net import CHAIN_ID, make_net, stop_net, wait_all_height
+from tests.test_types import make_block_id, make_commit, rand_validator_set
+
+
+class TestScheduler:
+    def test_requests_spread_across_peers(self):
+        s = Scheduler(initial_height=1, max_pending_per_peer=2)
+        s.set_peer_range("p1", 1, 10)
+        s.set_peer_range("p2", 1, 10)
+        reqs = s.next_requests(now=0.0)
+        for peer_id, h in reqs:
+            s.mark_requested(peer_id, h, 0.0)
+        assert len(reqs) == 4  # 2 per peer
+        heights = sorted(h for _, h in reqs)
+        assert heights == [1, 2, 3, 4]
+        by_peer = {}
+        for pid, h in reqs:
+            by_peer.setdefault(pid, []).append(h)
+        assert all(len(v) == 2 for v in by_peer.values())
+
+    def test_received_and_processed_advance(self):
+        s = Scheduler(1, max_pending_per_peer=10)
+        s.set_peer_range("p1", 1, 3)
+        for pid, h in s.next_requests(0.0):
+            s.mark_requested(pid, h, 0.0)
+        assert s.block_received("p1", 1)
+        assert not s.block_received("p2", 1)  # wrong peer: unsolicited
+        assert not s.block_received("p1", 9)  # never requested
+        s.block_received("p1", 2)
+        s.block_received("p1", 3)
+        s.block_processed(1)
+        s.block_processed(2)
+        assert not s.is_caught_up()
+        s.block_processed(3)
+        assert s.is_caught_up()
+
+    def test_remove_peer_reschedules(self):
+        s = Scheduler(1)
+        s.set_peer_range("p1", 1, 5)
+        s.set_peer_range("p2", 1, 5)
+        for pid, h in s.next_requests(0.0):
+            s.mark_requested(pid, h, 0.0)
+        freed = s.remove_peer("p1")
+        # freed heights get re-requested from p2
+        reqs = s.next_requests(0.0)
+        re_requested = {h for _, h in reqs}
+        assert set(freed) <= re_requested
+
+    def test_timeout_reassigns(self):
+        s = Scheduler(1, request_timeout=1.0)
+        s.set_peer_range("p1", 1, 2)
+        s.set_peer_range("p2", 1, 2)
+        reqs = dict((h, pid) for pid, h in s.next_requests(0.0))
+        for h, pid in reqs.items():
+            s.mark_requested(pid, h, 0.0)
+        # after the timeout everything is schedulable again
+        reqs2 = s.next_requests(now=5.0)
+        assert {h for _, h in reqs2} == set(reqs.keys())
+
+    def test_peer_base_respected(self):
+        s = Scheduler(1)
+        s.set_peer_range("pruned", base=50, height=100)
+        assert s.next_requests(0.0) == []  # peer pruned heights 1..49
+
+
+class TestProcessor:
+    def test_pairs_and_advance(self):
+        from tendermint_tpu.types import Block, Header
+
+        p = Processor(height=5)
+        mk = lambda h: Block(Header(chain_id="c", height=h), [])
+        p.add_block(6, mk(6), "p2")
+        assert p.peek_two() is None
+        p.add_block(5, mk(5), "p1")
+        first, second = p.peek_two()
+        assert first.height == 5 and second.height == 6
+        p.pop_processed()
+        assert p.height == 6
+
+    def test_drop_invalid_reports_peers(self):
+        from tendermint_tpu.types import Block, Header
+
+        p = Processor(height=5)
+        p.add_block(5, Block(Header(chain_id="c", height=5), []), "bad1")
+        p.add_block(6, Block(Header(chain_id="c", height=6), []), "bad2")
+        assert p.drop_invalid() == ("bad1", "bad2")
+        assert p.peek_two() is None
+
+
+class TestVerifyCommitRun:
+    def test_cross_height_batch(self):
+        vset, pvs = rand_validator_set(6)
+        pairs = []
+        for h in (10, 11, 12):
+            bid = make_block_id(bytes([h]))
+            commit = make_commit(vset, pvs, h, 0, bid)
+            pairs.append((bid, h, commit))
+        assert verify_commit_run(vset, "test-chain", pairs) == [True, True, True]
+        # tamper one height's commit: only that height fails
+        bad_bid = make_block_id(b"\x63")
+        bad = make_commit(vset, pvs, 13, 0, bad_bid)
+        bad.signatures[2] = bad.signatures[2].__class__(
+            bad.signatures[2].block_id_flag,
+            bad.signatures[2].validator_address,
+            bad.signatures[2].timestamp_ns,
+            b"\x00" * 64,
+        )
+        pairs.append((bad_bid, 13, bad))
+        assert verify_commit_run(vset, "test-chain", pairs) == [True, True, True, False]
+
+
+class TestFastSyncNet:
+    async def test_non_validator_fast_syncs(self, tmp_path):
+        """3 validators progress; a non-validator full node joins with
+        fast_sync on, downloads the chain, switches to consensus, and keeps
+        following the head."""
+        nodes, pvs = await make_net(tmp_path, 3, name="fs")
+        try:
+            await wait_all_height(nodes, 5)
+
+            cfg = make_test_cfg(str(tmp_path / "syncer"))
+            cfg.rpc.laddr = ""
+            cfg.base.db_backend = "memdb"
+            cfg.base.fast_sync = True
+            cfg.p2p.laddr = "127.0.0.1:0"
+            cfg.consensus.skip_timeout_commit = False
+            cfg.consensus.timeout_commit = 0.1
+            gen = GenesisDoc(
+                chain_id=CHAIN_ID,
+                genesis_time_ns=1_700_000_000_000_000_000,
+                validators=[
+                    GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs
+                ],
+            )
+            syncer = Node(cfg, gen, priv_validator=None, db_backend="memdb")
+            await syncer.start()
+            assert syncer.blockchain_reactor.fast_sync
+            for n in nodes:
+                addr = f"{n.node_key.id}@{n.switch.transport.listen_addr}"
+                await syncer.switch.dial_peer(addr)
+
+            # must catch up and then follow the moving head via consensus
+            target = nodes[0].block_store.height() + 3
+
+            async def synced():
+                while True:
+                    if syncer.block_store.height() >= target:
+                        return
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(synced(), 60.0)
+            assert syncer.blockchain_reactor.blocks_synced > 0
+            assert not syncer.blockchain_reactor.fast_sync  # switched over
+            h = target - 1
+            assert (
+                syncer.block_store.load_block(h).hash()
+                == nodes[0].block_store.load_block(h).hash()
+            )
+            await syncer.stop()
+        finally:
+            await stop_net(nodes)
