@@ -35,12 +35,16 @@ class Processor:
         self.blocks.pop(self.height, None)
         self.height += 1
 
-    def drop_invalid(self) -> Tuple[Optional[str], Optional[str]]:
+    def drop_invalid(self) -> Tuple[int, ...]:
         """Both blocks of the failing pair are suspect (v0 pool
-        RedoRequest): returns their peers for punishment."""
-        f = self.blocks.pop(self.height, None)
-        s = self.blocks.pop(self.height + 1, None)
-        return (f[1] if f else None, s[1] if s else None)
+        RedoRequest): drops them and returns the dropped heights.  Peer
+        attribution/punishment is the scheduler's job (it tracks who
+        delivered each height in `received`)."""
+        dropped = []
+        for h in (self.height, self.height + 1):
+            if self.blocks.pop(h, None) is not None:
+                dropped.append(h)
+        return tuple(dropped)
 
     def pending_range(self) -> int:
         return len(self.blocks)

@@ -142,7 +142,7 @@ class BlockchainReactor(Reactor):
                 and now - self._started_at > SWITCH_TO_CONSENSUS_INTERVAL
             ):
                 last_switch_check = now
-                if self.scheduler.is_caught_up():
+                if self.scheduler.only_tip_outstanding():
                     await self._switch_to_consensus()
                     return
             await asyncio.sleep(TRY_SYNC_INTERVAL)
@@ -162,13 +162,14 @@ class BlockchainReactor(Reactor):
                 )
             except Exception as e:
                 self.log.error("invalid block in fast sync", height=first.height, err=str(e))
-                p1, p2 = self.processor.drop_invalid()
-                for pid in (p1, p2):
+                for h in self.processor.drop_invalid():
+                    # block_invalid clears scheduler.received[h] and removes
+                    # the delivering peer, so the height gets re-requested
+                    # from the remaining honest peers
+                    pid = self.scheduler.block_invalid(h)
                     peer = self.switch.peers.get(pid) if pid else None
                     if peer is not None:
                         await self.switch.stop_peer_for_error(peer, "sent invalid block")
-                    if pid:
-                        self.scheduler.remove_peer(pid)
                 return
             self.block_store.save_block(
                 first, first.make_part_set(BLOCK_PART_SIZE_BYTES), second.last_commit

@@ -126,9 +126,15 @@ class Scheduler:
             if h in self.pending or h in self.received:
                 h += 1
                 continue
+            if not any(p.base <= h <= p.height for p in self.peers.values()):
+                # No peer retains height h at all (pruned below its base):
+                # processing is contiguous, so nothing past h can be applied —
+                # requesting ahead would only waste bandwidth and break the
+                # processor's two-contiguous-blocks invariant.
+                break
             peer = self._pick_peer_for(h)
             if peer is None:
-                h += 1
+                h += 1  # capacity-limited only: requesting ahead is fine
                 continue
             out.append((peer.peer_id, h))
             peer.pending.add(h)
@@ -149,8 +155,25 @@ class Scheduler:
         return min(candidates, key=lambda p: len(p.pending))
 
     def is_caught_up(self) -> bool:
-        """v0 pool.IsCaughtUp: at/above every peer's best height (with at
-        least one peer known)."""
+        """v0 pool.IsCaughtUp (blockchain/v0/pool.go:168): at/above every
+        peer's best height, with at least one peer known — and nothing
+        received but still unprocessed (switching to consensus while blocks
+        wait in the processor would drop them on the floor)."""
         if not self.peers:
             return False
-        return self.height >= self.max_peer_height()
+        return self.height >= self.max_peer_height() and not self.received
+
+    def only_tip_outstanding(self) -> bool:
+        """The v0 `maxPeerHeight-1` tolerance (blockchain/v0/pool.go:168),
+        made explicit: everything below tip-1 is processed, where tip is the
+        best claimed peer height.  The tip cannot be fastsync-verified —
+        verifying block H requires block H+1's commit — so the reactor hands
+        over to consensus, whose catchup gossip fetches the remainder.  The
+        -1 also keeps handover live when the tallest peer claims a height it
+        never delivers (reference v0 switches at maxPeerHeight-1 for the
+        same reason).  Received-but-unprocessed heights never block this:
+        the reactor exhausts processable pairs before checking, so whatever
+        remains is unprovable without future blocks."""
+        if not self.peers:
+            return False
+        return self.height >= self.max_peer_height() - 1

@@ -91,13 +91,13 @@ class TestProcessor:
         p.pop_processed()
         assert p.height == 6
 
-    def test_drop_invalid_reports_peers(self):
+    def test_drop_invalid_reports_heights(self):
         from tendermint_tpu.types import Block, Header
 
         p = Processor(height=5)
         p.add_block(5, Block(Header(chain_id="c", height=5), []), "bad1")
         p.add_block(6, Block(Header(chain_id="c", height=6), []), "bad2")
-        assert p.drop_invalid() == ("bad1", "bad2")
+        assert p.drop_invalid() == (5, 6)
         assert p.peek_two() is None
 
 
