@@ -47,8 +47,8 @@ def _bucket_size(n: int, multiple_of: int = 1) -> int:
 # host-side preparation
 # ---------------------------------------------------------------------------
 
-_N_LIMBS = 15
-_LIMB_BITS = 17
+_N_LIMBS = 20
+_LIMB_BITS = 13
 
 # Bounded LRU: pubkeys are attacker-suppliable (mempool/evidence paths), so
 # the cache must not grow without limit.  64k entries ≈ 32 MB worst case.
@@ -61,9 +61,9 @@ _decompress_cache: "_collections.OrderedDict[bytes, Optional[np.ndarray]]" = (
 
 
 def _neg_a_limbs(pubkey: bytes) -> Optional[np.ndarray]:
-    """Decompress pubkey and return extended coords of −A as [4, 15] int64
-    limbs; None for invalid encodings.  LRU-cached — validator pubkeys are
-    hot across heights."""
+    """Decompress pubkey and return extended coords of −A as [4, 20] int32
+    13-bit limbs; None for invalid encodings.  LRU-cached — validator
+    pubkeys are hot across heights."""
     if pubkey in _decompress_cache:
         _decompress_cache.move_to_end(pubkey)
         return _decompress_cache[pubkey]
@@ -74,7 +74,7 @@ def _neg_a_limbs(pubkey: bytes) -> Optional[np.ndarray]:
         x, y = aff
         nx = (em.P - x) % em.P
         ext = (nx, y, 1, nx * y % em.P)
-        limbs = np.zeros((4, _N_LIMBS), dtype=np.int64)
+        limbs = np.zeros((4, _N_LIMBS), dtype=np.int16)
         for c in range(4):
             v = ext[c]
             for i in range(_N_LIMBS):
@@ -85,18 +85,22 @@ def _neg_a_limbs(pubkey: bytes) -> Optional[np.ndarray]:
     return limbs
 
 
-def _msb_bits(values_be: np.ndarray) -> np.ndarray:
-    """[B, 32] big-endian byte rows -> [B, 256] MSB-first bits."""
-    return np.unpackbits(values_be, axis=1).astype(np.int64)
+def _msb_digits(values_le: np.ndarray) -> np.ndarray:
+    """[B, 32] little-endian scalar byte rows -> [B, 64] 4-bit window
+    digits, most-significant digit first (the kernel's ladder order)."""
+    dig = np.empty((values_le.shape[0], 64), dtype=np.uint8)
+    dig[:, 0::2] = values_le & 15  # little-endian digit 2k
+    dig[:, 1::2] = values_le >> 4  # little-endian digit 2k+1
+    return dig[:, ::-1]
 
 
 def _r_limbs_and_sign(r_bytes: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """[B, 32] little-endian R rows -> raw y limbs [B, 15] + sign bit [B]."""
-    bits = np.unpackbits(r_bytes, axis=1, bitorder="little").astype(np.int64)
-    sign = bits[:, 255].copy()
-    y_bits = bits[:, :255]
-    pow2 = 1 << np.arange(_LIMB_BITS, dtype=np.int64)
-    limbs = np.zeros((r_bytes.shape[0], _N_LIMBS), dtype=np.int64)
+    """[B, 32] little-endian R rows -> raw y limbs [B, 20] + sign bit [B]."""
+    bits = np.unpackbits(r_bytes, axis=1, bitorder="little")
+    sign = bits[:, 255].copy()  # uint8
+    y_bits = bits[:, :255].astype(np.int16)
+    pow2 = (1 << np.arange(_LIMB_BITS)).astype(np.int16)
+    limbs = np.zeros((r_bytes.shape[0], _N_LIMBS), dtype=np.int16)
     for j in range(_N_LIMBS):
         chunk = y_bits[:, j * _LIMB_BITS : (j + 1) * _LIMB_BITS]
         limbs[:, j] = chunk @ pow2[: chunk.shape[1]]
@@ -109,12 +113,13 @@ def _scalar_rows(
     """Shared per-signature host prep: SHA-512 h, scalar s, raw R limbs,
     canonical-S / length prefilters.  `items[i]` is (pubkey, msg, sig) or
     None when the caller already knows entry i is invalid.  Returns
-    (h_bits, s_bits, r_y_raw, r_sign, valid)."""
+    (h_digits, s_digits, r_y_raw, r_sign, valid)."""
     n = len(items)
-    h_be = np.zeros((n, 32), dtype=np.uint8)
-    s_be = np.zeros((n, 32), dtype=np.uint8)
-    r_le = np.zeros((n, 32), dtype=np.uint8)
     valid = np.zeros(n, dtype=bool)
+    zeros32 = bytes(32)
+    h_parts: list = [zeros32] * n
+    s_parts: list = [zeros32] * n
+    r_parts: list = [zeros32] * n
     for i, item in enumerate(items):
         if item is None:
             continue
@@ -122,36 +127,39 @@ def _scalar_rows(
         if len(sig) != 64 or not em.sc_minimal(sig[32:]):
             continue
         h = em.compute_hram(sig[:32], pk, msg)
-        h_be[i] = np.frombuffer(h.to_bytes(32, "big"), dtype=np.uint8)
-        s = int.from_bytes(sig[32:], "little")
-        s_be[i] = np.frombuffer(s.to_bytes(32, "big"), dtype=np.uint8)
-        r_le[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+        h_parts[i] = h.to_bytes(32, "little")
+        s_parts[i] = sig[32:]
+        r_parts[i] = sig[:32]
         valid[i] = True
+    # one frombuffer per column instead of 3n row-wise assignments
+    h_le = np.frombuffer(b"".join(h_parts), dtype=np.uint8).reshape(n, 32)
+    s_le = np.frombuffer(b"".join(s_parts), dtype=np.uint8).reshape(n, 32)
+    r_le = np.frombuffer(b"".join(r_parts), dtype=np.uint8).reshape(n, 32)
     r_y_raw, r_sign = _r_limbs_and_sign(r_le)
-    return _msb_bits(h_be), _msb_bits(s_be), r_y_raw, r_sign, valid
+    return _msb_digits(h_le), _msb_digits(s_le), r_y_raw, r_sign, valid
 
 
-def _pad_scalar_rows(b: int, h_bits, s_bits, r_y, r_sign):
+def _pad_scalar_rows(b: int, h_digits, s_digits, r_y, r_sign):
     """Pad the per-signature arrays up to bucket size b."""
-    n = h_bits.shape[0]
+    n = h_digits.shape[0]
     pad = b - n
     if pad <= 0:
-        return h_bits, s_bits, r_y, r_sign
+        return h_digits, s_digits, r_y, r_sign
     return (
-        np.concatenate([h_bits, np.zeros((pad, 256), dtype=np.int64)]),
-        np.concatenate([s_bits, np.zeros((pad, 256), dtype=np.int64)]),
-        np.concatenate([r_y, np.zeros((pad, _N_LIMBS), dtype=np.int64)]),
-        np.concatenate([r_sign, np.zeros(pad, dtype=np.int64)]),
+        np.concatenate([h_digits, np.zeros((pad, 64), dtype=np.uint8)]),
+        np.concatenate([s_digits, np.zeros((pad, 64), dtype=np.uint8)]),
+        np.concatenate([r_y, np.zeros((pad, _N_LIMBS), dtype=np.int16)]),
+        np.concatenate([r_sign, np.zeros(pad, dtype=np.uint8)]),
     )
 
 
 def prepare_batch(
     pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Host prep: returns (neg_a [B,4,15], h_bits [B,256], s_bits [B,256],
-    r_y_raw [B,15], r_sign [B], valid [B])."""
+    """Host prep: returns (neg_a [B,4,20], h_digits [B,64], s_digits [B,64],
+    r_y_raw [B,20], r_sign [B], valid [B])."""
     n = len(sigs)
-    neg_a = np.zeros((n, 4, _N_LIMBS), dtype=np.int64)
+    neg_a = np.zeros((n, 4, _N_LIMBS), dtype=np.int16)
     neg_a[:, 1, :1] = 1  # identity placeholder (0,1,1,0): y=z=1
     neg_a[:, 2, :1] = 1
     items: list = [None] * n
@@ -163,8 +171,8 @@ def prepare_batch(
             continue
         neg_a[i] = limbs
         items[i] = (pk, msg, sig)
-    h_bits, s_bits, r_y_raw, r_sign, valid = _scalar_rows(items)
-    return neg_a, h_bits, s_bits, r_y_raw, r_sign, valid
+    h_digits, s_digits, r_y_raw, r_sign, valid = _scalar_rows(items)
+    return neg_a, h_digits, s_digits, r_y_raw, r_sign, valid
 
 
 # ---------------------------------------------------------------------------
@@ -172,17 +180,31 @@ def prepare_batch(
 # ---------------------------------------------------------------------------
 
 
+_PALLAS_TILE = 512  # best-measured batch tile (sublane 20 x lane 512 blocks)
+
+
 class BatchVerifier:
     """Batched ed25519 verification, jitted per bucket shape.
 
-    With `mesh`, inputs/outputs are sharded over the batch axis
-    (data-parallel signatures across TPU chips over ICI).
+    On a TPU backend the Pallas kernel (ops/ed25519_pallas.py) runs the
+    whole ladder VMEM-resident — ~4x the fused-XLA kernel, ~20x the serial
+    host path.  On CPU (tests) or with `mesh` (multi-chip: inputs/outputs
+    sharded over the batch axis, data-parallel signatures over ICI) the
+    portable XLA kernel (ops/ed25519.py) is used instead.
     """
 
     def __init__(self, mesh=None, batch_axis: str = "batch"):
         self.mesh = mesh
         self.batch_axis = batch_axis
         self._fn = None
+        self._pallas = None  # resolved lazily: backend known only at first use
+
+    def _use_pallas(self) -> bool:
+        if self._pallas is None:
+            import jax
+
+            self._pallas = self.mesh is None and jax.default_backend() == "tpu"
+        return self._pallas
 
     def _jitted(self):
         if self._fn is None:
@@ -194,12 +216,17 @@ class BatchVerifier:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 data = NamedSharding(self.mesh, P(self.batch_axis))
-                repl = NamedSharding(self.mesh, P())
                 self._fn = jax.jit(
                     ed25519_kernel.verify_prepared,
                     in_shardings=(data, data, data, data, data),
                     out_shardings=data,
                 )
+            elif self._use_pallas():
+                import functools
+
+                from ..ops.ed25519_pallas import verify_prepared_pallas
+
+                self._fn = functools.partial(verify_prepared_pallas, tile=_PALLAS_TILE)
             else:
                 self._fn = jax.jit(ed25519_kernel.verify_prepared)
         return self._fn
@@ -209,20 +236,32 @@ class BatchVerifier:
             return 1
         return int(np.prod(list(self.mesh.shape.values())))
 
+    def _bucket(self, n: int) -> int:
+        if self._use_pallas():
+            # tile-aligned buckets: powers of two up to 2048, then
+            # multiples of 1024 — bounds padding waste at large batches
+            # (10k pads to 10240, not 16384); shapes are compile-cached
+            if n <= _PALLAS_TILE:
+                return _PALLAS_TILE
+            if n <= 2048:
+                return _bucket_size(n)
+            return ((n + 1023) // 1024) * 1024
+        return _bucket_size(n, self._pad_multiple())
+
     def verify(
         self, pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
     ) -> List[bool]:
         n = len(sigs)
         if n == 0:
             return []
-        neg_a, h_bits, s_bits, r_y, r_sign, valid = prepare_batch(pubkeys, msgs, sigs)
+        neg_a, h_digits, s_digits, r_y, r_sign, valid = prepare_batch(pubkeys, msgs, sigs)
         if not valid.any():
             return [False] * n
-        b = _bucket_size(n, self._pad_multiple())
+        b = self._bucket(n)
         if b > n:
             neg_a = np.concatenate([neg_a, np.tile(neg_a[-1:], (b - n, 1, 1))])
-        h_bits, s_bits, r_y, r_sign = _pad_scalar_rows(b, h_bits, s_bits, r_y, r_sign)
-        ok = np.asarray(self._jitted()(neg_a, h_bits, s_bits, r_y, r_sign))[:n]
+        h_digits, s_digits, r_y, r_sign = _pad_scalar_rows(b, h_digits, s_digits, r_y, r_sign)
+        ok = np.asarray(self._jitted()(neg_a, h_digits, s_digits, r_y, r_sign))[:n]
         return list(np.logical_and(ok, valid))
 
     def install(self) -> "BatchVerifier":
@@ -242,7 +281,7 @@ class PubkeyTable:
 
         self.verifier = verifier or BatchVerifier()
         n = len(pubkeys)
-        rows = np.zeros((max(n, 1), 4, _N_LIMBS), dtype=np.int64)
+        rows = np.zeros((max(n, 1), 4, _N_LIMBS), dtype=np.int32)
         rows[:, 1, :1] = 1
         rows[:, 2, :1] = 1
         self.row_valid = np.zeros(max(n, 1), dtype=bool)
@@ -253,37 +292,56 @@ class PubkeyTable:
                 rows[i] = limbs
                 self.row_valid[i] = True
         self.neg_a_rows = jnp.asarray(rows)  # device-resident
+        self._fused_fn = None
 
     def __len__(self) -> int:
         return len(self.pubkeys)
+
+    def _fused(self):
+        """One jitted dispatch: on-device gather of the pubkey rows fused
+        with the verify kernel — a second dispatch would pay the host↔device
+        round-trip latency twice (it is large on remote-attached TPUs)."""
+        if self._fused_fn is None:
+            import jax
+
+            inner = self.verifier._jitted()
+
+            def run(rows, idx, h, s, ry, rs):
+                import jax.numpy as jnp
+
+                return inner(jnp.take(rows, idx, axis=0), h, s, ry, rs)
+
+            self._fused_fn = jax.jit(run) if self.verifier.mesh is None else run
+        return self._fused_fn
 
     def verify_indexed(
         self, idxs: Sequence[int], msgs: Sequence[bytes], sigs: Sequence[bytes]
     ) -> List[bool]:
         """Verify msgs[i]/sigs[i] against table row idxs[i]."""
-        import jax.numpy as jnp
-
         n = len(sigs)
         if n == 0:
             return []
-        idx_arr = np.asarray(idxs, dtype=np.int64)
+        idx_arr = np.asarray(idxs, dtype=np.int32)
         # Host prep for everything except pubkey limbs (gathered on device);
         # entries with bad indices are marked invalid up front.
         items: list = [None] * n
-        for i, (idx, msg, sig) in enumerate(zip(idx_arr, msgs, sigs)):
-            if 0 <= idx < len(self.pubkeys) and self.row_valid[idx]:
+        pk_count = len(self.pubkeys)
+        idx_list = idx_arr.tolist()
+        for i, (idx, msg, sig) in enumerate(zip(idx_list, msgs, sigs)):
+            if 0 <= idx < pk_count and self.row_valid[idx]:
                 items[i] = (self.pubkeys[idx], msg, sig)
-        h_bits, s_bits, r_y, r_sign, valid = _scalar_rows(items)
+        h_digits, s_digits, r_y, r_sign, valid = _scalar_rows(items)
         if not valid.any():
             return [False] * n
 
-        b = _bucket_size(n, self.verifier._pad_multiple())
-        h_bits, s_bits, r_y, r_sign = _pad_scalar_rows(b, h_bits, s_bits, r_y, r_sign)
+        b = self.verifier._bucket(n)
+        h_digits, s_digits, r_y, r_sign = _pad_scalar_rows(b, h_digits, s_digits, r_y, r_sign)
         if b > n:
-            idx_arr = np.concatenate([idx_arr, np.zeros(b - n, dtype=np.int64)])
-        idx_arr = np.clip(idx_arr, 0, len(self.pubkeys) - 1)
-        neg_a = jnp.take(self.neg_a_rows, jnp.asarray(idx_arr), axis=0)
-        ok = np.asarray(self.verifier._jitted()(neg_a, h_bits, s_bits, r_y, r_sign))[:n]
+            idx_arr = np.concatenate([idx_arr, np.zeros(b - n, dtype=np.int32)])
+        idx_arr = np.clip(idx_arr, 0, pk_count - 1)
+        ok = np.asarray(
+            self._fused()(self.neg_a_rows, idx_arr, h_digits, s_digits, r_y, r_sign)
+        )[:n]
         return list(np.logical_and(ok, valid))
 
 

@@ -1,19 +1,17 @@
 """TPU compute kernels (JAX/XLA; Pallas where profiling demands).
 
-All kernels assume int64 is enabled — field arithmetic accumulates 17-bit
-limb products in int64 lanes.  Importing this package flips the JAX x64
-switch process-wide, which is deliberate: the framework owns the process.
+All field arithmetic is native int32 (13-bit limbs) — TPUs have no native
+int64, so the round-1 int64 design paid several emulated ops per multiply.
+
+A persistent compile cache is enabled: the curve kernels are expensive to
+compile (especially on the single-core CPU test host); the cache survives
+across processes so test/bench reruns skip recompilation.
 """
 
 import os
 
 import jax
 
-jax.config.update("jax_enable_x64", True)
-
-# Persistent compile cache: the 256-iteration curve kernels are expensive to
-# compile (especially on the single-core CPU test host); cache survives
-# across processes so test/bench reruns skip recompilation.
 _cache_dir = os.environ.get("TM_TPU_JAX_CACHE", "/root/repo/.jax_cache")
 try:
     jax.config.update("jax_compilation_cache_dir", _cache_dir)

@@ -1,0 +1,185 @@
+"""Twisted-Edwards point arithmetic, generic over the field backend.
+
+One copy of the consensus-critical curve layer — point add/madd/double
+(add-2008-hwcd-3 / madd-2008-hwcd-3 / dbl-2008-hwcd for a=-1), branch-free
+table selection, the d·(−A) table chain, the ref10 inversion addition
+chain, and strict canonicalization — shared by the XLA kernel
+(ops/ed25519.py) and the Pallas TPU kernel (ops/ed25519_pallas.py).  The
+two differ only in how field add/sub/mul/square propagate carries (pads vs
+sublane rolls), so they inject a small `fo` namespace providing:
+
+    fo.add(a, b)   fo.sub(a, b)   fo.mul(a, b)   fo.square(a)
+
+A field element is a [N_LIMBS, ...] int32 array; a point is a 4-tuple
+(X, Y, Z, T) of field elements.  All selection logic uses plain jnp.where,
+identical in both backends.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import fe
+
+
+def point_add(fo, p, q, two_d):
+    """Complete addition, add-2008-hwcd-3 (a=-1) — safe for P==Q and
+    identity, which is what makes the ladder branch-free."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = fo.mul(fo.sub(y1, x1), fo.sub(y2, x2))
+    b = fo.mul(fo.add(y1, x1), fo.add(y2, x2))
+    c = fo.mul(fo.mul(t1, two_d), t2)
+    zz = fo.mul(z1, z2)
+    d = fo.add(zz, zz)
+    e = fo.sub(b, a)
+    f = fo.sub(d, c)
+    g = fo.add(d, c)
+    h = fo.add(b, a)
+    return (fo.mul(e, f), fo.mul(g, h), fo.mul(f, g), fo.mul(e, h))
+
+
+def point_madd(fo, p, q3):
+    """Mixed addition with a precomputed affine point in madd form
+    q3 = (y2−x2, y2+x2, 2d·x2·y2), Z2=1 — 7 muls (madd-2008-hwcd-3)."""
+    x1, y1, z1, t1 = p
+    ymx2, ypx2, td2 = q3
+    a = fo.mul(fo.sub(y1, x1), ymx2)
+    b = fo.mul(fo.add(y1, x1), ypx2)
+    c = fo.mul(t1, td2)
+    d = fo.add(z1, z1)
+    e = fo.sub(b, a)
+    f = fo.sub(d, c)
+    g = fo.add(d, c)
+    h = fo.add(b, a)
+    return (fo.mul(e, f), fo.mul(g, h), fo.mul(f, g), fo.mul(e, h))
+
+
+def point_double(fo, p):
+    """dbl-2008-hwcd: 4 muls + 4 squares."""
+    x1, y1, z1, _ = p
+    a = fo.square(x1)
+    b = fo.square(y1)
+    zz = fo.square(z1)
+    c = fo.add(zz, zz)
+    h = fo.add(a, b)
+    e = fo.sub(h, fo.square(fo.add(x1, y1)))
+    g = fo.sub(a, b)
+    f = fo.add(c, g)
+    return (fo.mul(e, f), fo.mul(g, h), fo.mul(f, g), fo.mul(e, h))
+
+
+def point_where(m, p1, p0):
+    """Branch-free per-lane select between two points; m: [B] bool."""
+    mm = m[None, :]
+    return tuple(jnp.where(mm, c1, c0) for c1, c0 in zip(p1, p0))
+
+
+def select_point(entries, digit):
+    """entries: list of 16 points; digit: [B] int32 in [0,16).  4-level
+    where-tree — no gathers (TPU-hostile), complete in 15 selects."""
+    cur = list(entries)
+    for k in range(4):
+        bit = ((digit >> k) & 1).astype(bool)
+        cur = [point_where(bit, cur[2 * i + 1], cur[2 * i]) for i in range(len(cur) // 2)]
+    return cur[0]
+
+
+def select_triplet(entries, digit):
+    """Same where-tree over 16 3-tuples (madd-form base-table entries)."""
+    cur = list(entries)
+    for k in range(4):
+        bit = ((digit >> k) & 1).astype(bool)[None, :]
+        cur = [
+            tuple(jnp.where(bit, c1, c0) for c1, c0 in zip(cur[2 * i + 1], cur[2 * i]))
+            for i in range(len(cur) // 2)
+        ]
+    return cur[0]
+
+
+def neg_a_table(fo, a1, identity, two_d):
+    """d·(−A) for d=0..15: 7 doubles + 7 adds, shared-subexpression chain."""
+    tab = [identity] * 16
+    tab[1] = a1
+    tab[2] = point_double(fo, tab[1])
+    tab[3] = point_add(fo, tab[2], a1, two_d)
+    tab[4] = point_double(fo, tab[2])
+    tab[5] = point_add(fo, tab[4], a1, two_d)
+    tab[6] = point_double(fo, tab[3])
+    tab[7] = point_add(fo, tab[6], a1, two_d)
+    tab[8] = point_double(fo, tab[4])
+    tab[9] = point_add(fo, tab[8], a1, two_d)
+    tab[10] = point_double(fo, tab[5])
+    tab[11] = point_add(fo, tab[10], a1, two_d)
+    tab[12] = point_double(fo, tab[6])
+    tab[13] = point_add(fo, tab[12], a1, two_d)
+    tab[14] = point_double(fo, tab[7])
+    tab[15] = point_add(fo, tab[14], a1, two_d)
+    return tab
+
+
+def invert(fo, z):
+    """z^(p-2) via the standard ed25519 addition chain (ref10 fe_invert:
+    254 squarings + 11 multiplies), vectorized over the whole batch."""
+
+    def sq_n(x, n):
+        # fori_loop keeps the traced graph one squaring deep
+        return lax.fori_loop(0, n, lambda _, v: fo.square(v), x)
+
+    z2 = fo.square(z)  # 2
+    z8 = sq_n(z2, 2)  # 8
+    z9 = fo.mul(z8, z)  # 9
+    z11 = fo.mul(z9, z2)  # 11
+    z22 = fo.square(z11)  # 22
+    z_5_0 = fo.mul(z22, z9)  # 2^5 - 2^0
+    z_10_0 = fo.mul(sq_n(z_5_0, 5), z_5_0)  # 2^10 - 2^0
+    z_20_0 = fo.mul(sq_n(z_10_0, 10), z_10_0)  # 2^20 - 2^0
+    z_40_0 = fo.mul(sq_n(z_20_0, 20), z_20_0)  # 2^40 - 2^0
+    z_50_0 = fo.mul(sq_n(z_40_0, 10), z_10_0)  # 2^50 - 2^0
+    z_100_0 = fo.mul(sq_n(z_50_0, 50), z_50_0)  # 2^100 - 2^0
+    z_200_0 = fo.mul(sq_n(z_100_0, 100), z_100_0)  # 2^200 - 2^0
+    z_250_0 = fo.mul(sq_n(z_200_0, 50), z_50_0)  # 2^250 - 2^0
+    return fo.mul(sq_n(z_250_0, 5), z11)  # 2^255 - 21 = p - 2
+
+
+def canonical(x):
+    """Full reduction to [0, p) with strictly normalized limbs — required
+    before the byte-compare against a signature's raw R limbs (a partially
+    reduced representative would wrongly fail limb-wise equality; a rare
+    consensus-fork hazard).  Sequential row chains are fine: this runs
+    twice per verification, not inside the ladder.  Pure jnp — identical
+    in both backends."""
+    n = fe.N_LIMBS
+    bits = fe.LIMB_BITS
+    mask = fe.MASK
+
+    def seq_carry(rows):
+        out = []
+        carry = jnp.zeros_like(rows[0])
+        for i in range(n):
+            v = rows[i] + carry
+            carry = v >> bits
+            out.append(v & mask)
+        return out, carry
+
+    rows = [x[i : i + 1] for i in range(n)]
+    rows, carry = seq_carry(rows)  # value < 1.3*2^260 -> carry <= 1
+    rows[0] = rows[0] + fe.FOLD * carry
+    rows, _ = seq_carry(rows)  # value now < 2^260 -> no top carry
+    # fold bits >= 255 (top 5 bits of limb 19): 2^255 ≡ 19
+    top = rows[n - 1] >> 8
+    rows[n - 1] = rows[n - 1] & 0xFF
+    rows[0] = rows[0] + 19 * top
+    rows, _ = seq_carry(rows)  # value < 2^255 + 589 < 2p
+    p_limbs = [int(fe.P_LIMBS[i, 0]) for i in range(n)]
+    for _ in range(2):
+        borrow = jnp.zeros_like(rows[0])
+        t = []
+        for i in range(n):
+            v = rows[i] - p_limbs[i] - borrow
+            borrow = (v < 0).astype(jnp.int32)
+            t.append(v + borrow * (mask + 1))
+        keep = borrow == 0
+        rows = [jnp.where(keep, ti, ri) for ti, ri in zip(t, rows)]
+    return jnp.concatenate(rows, axis=0)

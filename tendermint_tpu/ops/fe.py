@@ -1,156 +1,185 @@
-"""GF(2^255-19) field arithmetic on uniform 17-bit limbs, vectorized.
+"""GF(2^255-19) field arithmetic on 20 x 13-bit limbs in native int32.
 
 The TPU-native replacement for the serial bignum inside the reference's
-ed25519 dependency (crypto/ed25519/ed25519.go:151 VerifyBytes).  Field
-elements are [..., 15] int64 arrays: value = Σ limb_i · 2^(17·i), limbs kept
-in [0, 2^17) between operations.  The uniform radix makes reduction a single
-·19 fold (2^255 ≡ 19 mod p) with no per-limb special cases — every op is a
-short static sequence of vector adds/mults that XLA fuses across the batch
-dimension, which is where the parallelism lives (one lane per signature).
+ed25519 dependency (crypto/ed25519/ed25519.go:151 VerifyBytes).  A field
+element is a single [N_LIMBS, B] int32 array — limb-major, so the batch
+axis B rides the vector lanes and every operation below is a full-width
+VPU op over all signatures at once.  Compile-time constants are numpy
+[N_LIMBS, 1] arrays that broadcast over the batch.
 
-Magnitude analysis for fe_mul: limbs < 2^17 ⇒ conv coeffs < 15·2^34 < 2^38
-⇒ after ·19 fold < 2^43 ⇒ int64 accumulation is exact.
+Why this design (vs the round-1 [..., 15] int64 @ 17 bits/limb):
+  * TPUs have no native int64 — every int64 multiply is emulated.  13-bit
+    limbs make every product and partial sum fit exactly in int32.
+  * limb-major [20, B] puts B on the 128-wide lane axis (B is a multiple
+    of 128 after bucket padding) instead of wasting lanes on a trailing
+    limb axis.
+  * carry propagation is "carry-save": a few whole-array passes of
+    shift/mask/add instead of a 20-step sequential chain, keeping the op
+    count (and XLA graph) small.
+
+Magnitude analysis (invariant: limbs <= 10016 between operations):
+  mul conv:   coeff <= 20 * 10016^2           = 2.007e9 < 2^31 - 1  exact
+  square:     coeff <= (10*2 + 1) * 10016^2   = 2.107e9 < 2^31      exact
+  add out:    <= 8191 + 608*2  = 9407  <= 10016
+  sub out:    <= 8191 + 608*3  = 10015 <= 10016  (bias = 64p, below)
+  mul out:    <= 8799 (row 0) / 8237 (rest)    <= 10016
+  (per-pass carry bounds are verified inline in _reduce_conv)
+
+Folding: 2^260 ≡ 2^5·19 = 608 (mod p), and for the transient 41st
+convolution row 2^520 ≡ 608² = 369664.
+
+Subtraction bias: a - b is computed as a + (64p) - b with 64p decomposed
+into per-limb constants all >= 15168 > 10016 >= max limb of b, so every
+partial stays in [0, 2^15).  64p is the smallest power-of-two multiple of
+p with such a 20-limb decomposition (32p < 2^260 - 1 already fails).
 """
 
 from __future__ import annotations
 
-import jax.numpy as jnp
+import numpy as np
 
-N_LIMBS = 15
-LIMB_BITS = 17
+import jax.numpy as jnp
+from jax import lax
+
+N_LIMBS = 20
+LIMB_BITS = 13
 MASK = (1 << LIMB_BITS) - 1
 P_INT = 2**255 - 19
+FOLD = 608  # 2^260 mod p
+FOLD2 = FOLD * FOLD  # 2^520 mod p
 
 
-def from_int(v: int) -> jnp.ndarray:
-    """Host helper: python int -> limb vector (for constants)."""
-    return jnp.array([(v >> (LIMB_BITS * i)) & MASK for i in range(N_LIMBS)], dtype=jnp.int64)
+def from_int(v: int) -> np.ndarray:
+    """python int -> [N_LIMBS, 1] int32 constant (broadcasts over batch)."""
+    return np.array(
+        [[(v >> (LIMB_BITS * i)) & MASK] for i in range(N_LIMBS)], dtype=np.int32
+    )
 
 
-def to_int(limbs) -> int:
-    """Host helper for tests: limb vector -> python int."""
-    import numpy as np
+def to_int(x, lane: int = 0) -> int:
+    """Host helper for tests: lane `lane` of a [N_LIMBS, B] array -> int."""
+    arr = np.asarray(x)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    return sum(int(arr[i, lane]) << (LIMB_BITS * i) for i in range(N_LIMBS))
 
-    arr = np.asarray(limbs, dtype=object)
-    return sum(int(arr[..., i]) << (LIMB_BITS * i) for i in range(N_LIMBS))
 
-
-# p and 2p as limb constants (2p added before subtraction keeps limbs >= 0).
-# 2p exceeds 15·17 bits, so it is kept as unnormalized doubled limbs —
-# carry() renormalizes after the subtraction.
 P_LIMBS = from_int(P_INT)
-TWO_P_LIMBS = 2 * P_LIMBS
 
 
-def zeros(shape=()) -> jnp.ndarray:
-    return jnp.zeros(shape + (N_LIMBS,), dtype=jnp.int64)
+def _bias_limbs() -> np.ndarray:
+    """Per-limb decomposition of 64p with every limb in [15168, 16382]."""
+    d = 64 * P_INT - (2**260 - 1)  # distribute 8191 to every limb first
+    assert d >= 0
+    digits = [(d >> (LIMB_BITS * i)) & MASK for i in range(N_LIMBS)]
+    bias = np.array([[8191 + dig] for dig in digits], dtype=np.int32)
+    assert sum(int(b) << (LIMB_BITS * i) for i, b in enumerate(bias[:, 0])) == 64 * P_INT
+    assert all(15168 <= int(b) <= 16382 for b in bias[:, 0])
+    # the Pallas kernel builds this bias as where(row==0, bias[0], bias[1]);
+    # that shortcut is only sound while limbs 1..19 are uniform
+    assert all(int(b) == int(bias[1, 0]) for b in bias[1:, 0])
+    return bias
 
 
-def carry(x: jnp.ndarray, rounds: int = 2) -> jnp.ndarray:
-    """Propagate carries; after 2 rounds limbs are in [0, 2^17) for any
-    input bounded by the fe_mul analysis above (top-carry folds ·19 into
-    limb 0).  Inputs with negative limbs need the caller to pre-bias by 2p.
-    """
-    for _ in range(rounds):
-        out = []
-        c = jnp.zeros(x.shape[:-1], dtype=jnp.int64)
-        for i in range(N_LIMBS):
-            v = x[..., i] + c
-            c = v >> LIMB_BITS
-            out.append(v & MASK)
-        x = jnp.stack(out, axis=-1)
-        x = x.at[..., 0].add(19 * c)
-    return x
+BIAS_64P = _bias_limbs()
 
 
-def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return carry(a + b, rounds=1)
+def broadcast_const(c: np.ndarray, batch: int) -> jnp.ndarray:
+    return jnp.broadcast_to(jnp.asarray(c), (N_LIMBS, batch))
 
 
-def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """a - b; bias by 2p so limbs stay non-negative before carrying."""
-    return carry(a + TWO_P_LIMBS - b, rounds=2)
+def _cs_pass(v: jnp.ndarray, top_fold: int = FOLD) -> jnp.ndarray:
+    """One carry-save pass: extract carries, shift them up one limb, fold
+    the top limb's carry back via `top_fold` (its weight mod p).  Built
+    from elementwise ops + pads only — no scatter/dynamic-update-slice, so
+    XLA fuses whole passes into the surrounding computation."""
+    n = v.shape[0]
+    carry = v >> LIMB_BITS
+    v = v & MASK
+    shifted = jnp.pad(carry[:-1], ((1, 0),) + ((0, 0),) * (v.ndim - 1))
+    fold = jnp.pad((top_fold * carry[-1])[None], ((0, n - 1),) + ((0, 0),) * (v.ndim - 1))
+    return v + shifted + fold
 
 
-def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook limb convolution + single ·19 fold."""
-    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    prod = jnp.zeros(shape + (2 * N_LIMBS - 1,), dtype=jnp.int64)
-    for i in range(N_LIMBS):
-        prod = prod.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
-    lo = prod[..., :N_LIMBS]
-    hi = prod[..., N_LIMBS:]
-    lo = lo.at[..., : N_LIMBS - 1].add(19 * hi)
-    return carry(lo, rounds=2)
+def add(a, b):
+    # inputs <= 10016 each -> v <= 20032; one pass: carry <= 2, out <= 9407
+    return _cs_pass(a + b)
 
 
-def square(a: jnp.ndarray) -> jnp.ndarray:
-    return mul(a, a)
+def sub(a, b):
+    # v in [5152, 26401]; one pass: carry <= 3, out <= 10015
+    return _cs_pass(a + BIAS_64P - b)
 
 
-def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
-    return carry(a * k, rounds=2)
+def _reduce_conv(c: jnp.ndarray) -> jnp.ndarray:
+    """[39 or 40, B] convolution coefficients (<= 2.11e9) -> [20, B] limbs
+    within the <= 10016 invariant."""
+    pad = 41 - c.shape[0]
+    c = jnp.concatenate([c, jnp.zeros((pad,) + c.shape[1:], c.dtype)], axis=0)
+    # pass 1: carries <= 245k shift into rows 1..39; rows 39,40 were zero so
+    # the 2^520 top fold multiplies a zero carry (no overflow possible)
+    c = _cs_pass(c, top_fold=FOLD2)
+    # pass 2: carries <= 30; row-40 carry <= 29 -> fold <= 10.8M
+    c = _cs_pass(c, top_fold=FOLD2)
+    # pass 3: carries <= 1 -> rows <= 8192, row 40 <= 30
+    c = _cs_pass(c, top_fold=FOLD2)
+    # fold 41 rows -> 20: row 20+i folds with 608, transient row 40 with 608²
+    lo = c[:N_LIMBS] + FOLD * c[N_LIMBS : 2 * N_LIMBS]
+    top = jnp.pad(
+        (FOLD2 * c[2 * N_LIMBS])[None], ((0, N_LIMBS - 1),) + ((0, 0),) * (lo.ndim - 1)
+    )
+    lo = lo + top
+    # lo <= 4.99M (row 0 <= 16.1M); two passes land within the invariant:
+    # pass 1: carry <= 1965, top fold <= 608*609 -> row0 <= 378463
+    # pass 2: carry <= 46, top fold <= 608 -> row0 <= 8799, rows <= 8237
+    lo = _cs_pass(lo)
+    lo = _cs_pass(lo)
+    return lo
 
 
-def canonical(x: jnp.ndarray) -> jnp.ndarray:
-    """Full reduction to [0, p) with strictly normalized limbs.
-
-    carry()'s final ·19 fold can leave limb 0 slightly above 2^17 while the
-    value is already < p; the conditional subtract below would then keep the
-    unnormalized limbs and limb-wise comparison against reduced encodings
-    would wrongly fail (a ~2^-20-rare consensus-fork hazard).  Re-carrying
-    first guarantees limbs in [0, 2^17): the inputs here are near-reduced,
-    so round 1 propagates the excess with a zero top carry and round 2 is a
-    no-op."""
-    x = carry(x, rounds=2)
-    for _ in range(2):
-        borrow = jnp.zeros(x.shape[:-1], dtype=jnp.int64)
-        out = []
-        for i in range(N_LIMBS):
-            v = x[..., i] - P_LIMBS[i] - borrow
-            borrow = (v < 0).astype(jnp.int64)
-            out.append(v + borrow * (MASK + 1))
-        t = jnp.stack(out, axis=-1)
-        # if no final borrow, x >= p: take the subtracted value
-        x = jnp.where((borrow == 0)[..., None], t, x)
-    return x
+def _conv(a, b):
+    """[20, B] x [20, B] -> [39, B] limb convolution as one fused
+    broadcast-multiply + shifted-flatten + reduction (no scatters):
+    P[i, j] = a_i * b_j is padded to [20, 40, B], flattened, and trimmed so
+    row i lands shifted right by i — summing rows then yields
+    c_k = sum_{i+j=k} a_i b_j."""
+    batch = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    p = a[:, None] * b[None, :]  # [20, 20, B]
+    p = jnp.broadcast_to(p, (N_LIMBS, N_LIMBS) + batch)
+    p = jnp.pad(p, ((0, 0), (0, N_LIMBS)) + ((0, 0),) * len(batch))
+    flat = p.reshape((2 * N_LIMBS * N_LIMBS,) + batch)
+    flat = flat[: N_LIMBS * (2 * N_LIMBS - 1)]
+    return flat.reshape((N_LIMBS, 2 * N_LIMBS - 1) + batch).sum(axis=0)
 
 
-def invert(z: jnp.ndarray) -> jnp.ndarray:
-    """z^(p-2) via the standard ed25519 addition chain (ref10 fe_invert
-    structure: 254 squarings + 11 multiplies)."""
-
-    from jax import lax
-
-    def sq_n(x, n):
-        # fori_loop keeps the traced graph one squaring deep — unrolling the
-        # 254 squarings made XLA compile times explode
-        return lax.fori_loop(0, n, lambda _, v: square(v), x)
-
-    z2 = square(z)  # 2
-    z8 = sq_n(z2, 2)  # 8
-    z9 = mul(z8, z)  # 9
-    z11 = mul(z9, z2)  # 11
-    z22 = square(z11)  # 22
-    z_5_0 = mul(z22, z9)  # 2^5 - 2^0 = 31
-    z_10_5 = sq_n(z_5_0, 5)
-    z_10_0 = mul(z_10_5, z_5_0)  # 2^10 - 2^0
-    z_20_10 = sq_n(z_10_0, 10)
-    z_20_0 = mul(z_20_10, z_10_0)  # 2^20 - 2^0
-    z_40_20 = sq_n(z_20_0, 20)
-    z_40_0 = mul(z_40_20, z_20_0)  # 2^40 - 2^0
-    z_50_10 = sq_n(z_40_0, 10)
-    z_50_0 = mul(z_50_10, z_10_0)  # 2^50 - 2^0
-    z_100_50 = sq_n(z_50_0, 50)
-    z_100_0 = mul(z_100_50, z_50_0)  # 2^100 - 2^0
-    z_200_100 = sq_n(z_100_0, 100)
-    z_200_0 = mul(z_200_100, z_100_0)  # 2^200 - 2^0
-    z_250_50 = sq_n(z_200_0, 50)
-    z_250_0 = mul(z_250_50, z_50_0)  # 2^250 - 2^0
-    z_255_5 = sq_n(z_250_0, 5)
-    return mul(z_255_5, z11)  # 2^255 - 21 = p - 2
+def mul(a, b):
+    """Schoolbook limb convolution; exact in int32 per the header analysis."""
+    return _reduce_conv(_conv(a, b))
 
 
-def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Limb-wise equality (callers canonicalize first); [...] bool."""
-    return jnp.all(a == b, axis=-1)
+def square(a):
+    """mul(a, a); the symmetric-half optimization is not worth breaking the
+    single fused convolution pattern for."""
+    return _reduce_conv(_conv(a, a))
+
+
+def canonical(x):
+    """Full reduction to [0, p): delegates to the shared curve layer (one
+    copy of the consensus-critical normalization for both backends)."""
+    from . import curve
+
+    return curve.canonical(x)
+
+
+def invert(z):
+    """z^(p-2): delegates to the shared addition chain in ops/curve.py."""
+    import sys
+
+    from . import curve
+
+    return curve.invert(sys.modules[__name__], z)
+
+
+def eq(a, b) -> jnp.ndarray:
+    """Limb-wise equality (callers canonicalize first); [B] bool."""
+    return jnp.all(a == b, axis=0)

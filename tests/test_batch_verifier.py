@@ -69,18 +69,52 @@ class TestFieldOps:
         from tendermint_tpu.ops import ed25519_kernel as ek
         from tendermint_tpu.ops import fe
 
-        def to_ext_limbs(pt):
-            return jnp.stack([fe.from_int(c) for c in pt])
+        def to_ext(pt):  # batch of 1 lane
+            return tuple(jnp.asarray(fe.from_int(c)) for c in pt)
 
-        def from_ext_limbs(arr):
-            return tuple(fe.to_int(fe.canonical(arr[c])) for c in range(4))
+        def from_ext(p):
+            return tuple(fe.to_int(fe.canonical(c)) for c in p)
 
         b2 = em.point_double(em.BASE)
         b3 = em.point_add(b2, em.BASE)
-        got = from_ext_limbs(ek.point_add(to_ext_limbs(b2), to_ext_limbs(em.BASE))[...])
+        got = from_ext(ek.point_add(to_ext(b2), to_ext(em.BASE)))
         assert em.to_affine(got[:2] + got[2:]) == em.to_affine(b3)
-        got_d = from_ext_limbs(ek.point_double(to_ext_limbs(em.BASE)))
+        got_d = from_ext(ek.point_double(to_ext(em.BASE)))
         assert em.to_affine(got_d[:2] + got_d[2:]) == em.to_affine(b2)
+
+    def test_field_torture_int32_bounds(self):
+        """Randomized + adversarial values (all-ones limbs, p-1, 2p-ish)
+        exercising the int32 magnitude analysis in ops/fe.py."""
+        import jax.numpy as jnp
+
+        from tendermint_tpu.ops import fe
+
+        rng = np.random.default_rng(7)
+        specials = [0, 1, 19, em.P - 1, em.P - 19, 2**255 - 20, 2**252 + 27742317777372353535851937790883648493]
+        vals = specials + [int(rng.integers(0, 2**63)) ** 4 % em.P for _ in range(9)]
+
+        def lanes(ints):  # [20, n] with one lane per value
+            arr = np.zeros((fe.N_LIMBS, len(ints)), np.int32)
+            for lane, v in enumerate(ints):
+                arr[:, lane] = fe.from_int(v)[:, 0]
+            return jnp.asarray(arr)
+
+        def to_ints(arr):
+            arr = np.asarray(arr)
+            return [fe.to_int(arr, lane) for lane in range(arr.shape[1])]
+
+        a = lanes(vals)
+        b = lanes(list(reversed(vals)))
+        got_mul = to_ints(fe.canonical(fe.mul(a, b)))
+        got_sq = to_ints(fe.canonical(fe.square(a)))
+        got_add = to_ints(fe.canonical(fe.add(a, b)))
+        got_sub = to_ints(fe.canonical(fe.sub(a, b)))
+        rv = list(reversed(vals))
+        for i, (x, y) in enumerate(zip(vals, rv)):
+            assert got_mul[i] == x * y % em.P
+            assert got_sq[i] == x * x % em.P
+            assert got_add[i] == (x + y) % em.P
+            assert got_sub[i] == (x - y) % em.P
 
 
 # ---------------------------------------------------------------------------
@@ -151,6 +185,34 @@ class TestBatchVerifier:
 
     def test_empty_batch(self, verifier):
         assert verifier.verify([], [], []) == []
+
+
+class TestPallasKernel:
+    def test_differential_vs_oracle_interpret(self):
+        """The Pallas kernel is the default verify path on TPU backends;
+        cover its exact code on CPU via the Pallas interpreter."""
+        import numpy as np
+
+        from tendermint_tpu.crypto.batch_verifier import prepare_batch
+        from tendermint_tpu.ops.ed25519_pallas import verify_prepared_pallas
+
+        rng = np.random.default_rng(11)
+        pubkeys, msgs, sigs = make_sigs(8)
+        mutated = []
+        for sig in sigs:
+            if rng.random() < 0.5:
+                b = bytearray(sig)
+                b[rng.integers(0, 64)] ^= 1 << rng.integers(0, 8)
+                mutated.append(bytes(b))
+            else:
+                mutated.append(sig)
+        neg_a, h, s, ry, rs, valid = prepare_batch(pubkeys, msgs, mutated)
+        ok = np.asarray(
+            verify_prepared_pallas(neg_a, h, s, ry, rs, tile=8, interpret=True)
+        )
+        got = list(np.logical_and(ok, valid))
+        want = [em.verify(pk, m, sg) for pk, m, sg in zip(pubkeys, msgs, mutated)]
+        assert got == want
 
 
 class TestPubkeyTable:
