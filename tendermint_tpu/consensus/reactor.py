@@ -275,18 +275,33 @@ class ConsensusReactor(Reactor):
         elif chan_id == DATA_CHANNEL:
             if kind == "proposal":
                 proposal = Proposal.from_dict(msg["proposal"])
+                try:  # ValidateBasic on ingress (reactor.go:222)
+                    proposal.validate_basic()
+                except ValueError as e:
+                    await self.switch.stop_peer_for_error(peer, f"invalid proposal: {e}")
+                    return
                 ps.set_has_proposal(proposal)
                 await self.cs.set_proposal_input(proposal, peer.id)
             elif kind == "proposal_pol":
                 ps.apply_proposal_pol(msg)
             elif kind == "block_part":
-                ps.set_has_proposal_block_part(msg["height"], msg["round"], msg["part"]["index"])
-                await self.cs.add_block_part_input(
-                    msg["height"], msg["round"], Part.from_dict(msg["part"]), peer.id
-                )
+                part = Part.from_dict(msg["part"])
+                try:
+                    part.validate_basic()
+                except ValueError as e:
+                    await self.switch.stop_peer_for_error(peer, f"invalid block part: {e}")
+                    return
+                ps.set_has_proposal_block_part(msg["height"], msg["round"], part.index)
+                await self.cs.add_block_part_input(msg["height"], msg["round"], part, peer.id)
         elif chan_id == VOTE_CHANNEL:
             if kind == "vote":
                 vote = Vote.from_dict(msg["vote"])
+                try:  # a signed vote with a malformed BlockID must not
+                    # enter vote sets (reactor.go:222 ValidateBasic)
+                    vote.validate_basic()
+                except ValueError as e:
+                    await self.switch.stop_peer_for_error(peer, f"invalid vote: {e}")
+                    return
                 height = self.cs.rs.height
                 val_size = self.cs.rs.validators.size() if self.cs.rs.validators else 0
                 last_size = (

@@ -44,7 +44,7 @@ from ..types.part_set import Part, PartSet, PartSetError
 from ..types.params import BLOCK_PART_SIZE_BYTES
 from ..types.vote import VoteError
 from .ticker import TimeoutInfo, TimeoutTicker
-from .types import HeightVoteSet, RoundState, RoundStep
+from .types import GotVoteFromUnwantedRoundError, HeightVoteSet, RoundState, RoundStep
 from .wal import NilWAL
 
 
@@ -260,7 +260,10 @@ class ConsensusState(Service):
         except ErrVoteConflictingVotes:
             raise  # own double-sign — _try_add_vote re-raises only then; halt
         except (VoteError, PartSetError, InvalidProposalSignatureError,
-                InvalidProposalPOLRoundError) as e:
+                InvalidProposalPOLRoundError, GotVoteFromUnwantedRoundError) as e:
+            # peer errors: log and keep the receive loop alive — a byzantine
+            # peer must not be able to halt consensus (reactor.go:222 treats
+            # these as peer misbehaviour, not consensus failure)
             self.log.debug("error with msg", kind=kind, peer=peer_id, err=str(e))
 
     async def _handle_timeout(self, ti: TimeoutInfo) -> None:
@@ -720,7 +723,20 @@ class ConsensusState(Service):
                 return False  # wrong-round part, not necessarily malicious
             raise
         if added and rs.proposal_block_parts.is_complete():
-            rs.proposal_block = Block.deserialize(rs.proposal_block_parts.assemble())
+            try:
+                block = Block.deserialize(rs.proposal_block_parts.assemble())
+            except Exception as e:
+                # A maliciously assembled part set decodes to garbage: reset
+                # so honest parts can rebuild, and surface a peer error
+                # instead of killing the receive loop (state.go:1655 returns
+                # err; reactor treats it as peer misbehaviour).
+                rs.proposal_block_parts = (
+                    PartSet.from_header(rs.proposal.block_id.parts_header)
+                    if rs.proposal is not None
+                    else None
+                )
+                raise PartSetError(f"proposal block does not decode: {e!r}") from e
+            rs.proposal_block = block
             self.log.info(
                 "received complete proposal block",
                 height=rs.proposal_block.height,

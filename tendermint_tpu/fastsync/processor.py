@@ -46,6 +46,13 @@ class Processor:
                 dropped.append(h)
         return tuple(dropped)
 
+    def drop_heights(self, heights) -> None:
+        """Forget blocks whose delivering peer was removed so the scheduler's
+        re-request actually replaces them (otherwise add_block's setdefault
+        would keep the stale copy)."""
+        for h in heights:
+            self.blocks.pop(h, None)
+
     def pending_range(self) -> int:
         return len(self.blocks)
 

@@ -73,7 +73,8 @@ class BlockchainReactor(Reactor):
             self.scheduler.add_peer(peer.id)
 
     async def remove_peer(self, peer, reason=None) -> None:
-        self.scheduler.remove_peer(peer.id)
+        freed = self.scheduler.remove_peer(peer.id)
+        self.processor.drop_heights(freed)
 
     # -- receive -----------------------------------------------------------
     async def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
@@ -128,7 +129,7 @@ class BlockchainReactor(Reactor):
             for peer_id, height in self.scheduler.next_requests(now):
                 peer = self.switch.peers.get(peer_id)
                 if peer is None:
-                    self.scheduler.remove_peer(peer_id)
+                    self.processor.drop_heights(self.scheduler.remove_peer(peer_id))
                     continue
                 if peer.try_send(BLOCKCHAIN_CHANNEL, _enc("block_request", {"height": height})):
                     self.scheduler.mark_requested(peer_id, height, now)
@@ -163,10 +164,12 @@ class BlockchainReactor(Reactor):
             except Exception as e:
                 self.log.error("invalid block in fast sync", height=first.height, err=str(e))
                 for h in self.processor.drop_invalid():
-                    # block_invalid clears scheduler.received[h] and removes
-                    # the delivering peer, so the height gets re-requested
-                    # from the remaining honest peers
-                    pid = self.scheduler.block_invalid(h)
+                    # block_invalid clears scheduler.received[h], removes the
+                    # delivering peer, and frees that peer's other queued
+                    # deliveries; drop those from the processor too so the
+                    # re-requested copies are not shadowed by stale ones
+                    pid, freed = self.scheduler.block_invalid(h)
+                    self.processor.drop_heights(freed)
                     peer = self.switch.peers.get(pid) if pid else None
                     if peer is not None:
                         await self.switch.stop_peer_for_error(peer, "sent invalid block")

@@ -56,7 +56,11 @@ class Scheduler:
         p.base, p.height = base, height
 
     def remove_peer(self, peer_id: str) -> List[int]:
-        """Returns heights that must be rescheduled."""
+        """Returns heights that must be rescheduled: both in-flight requests
+        and received-but-unprocessed blocks this peer delivered (v0
+        pool.removePeer redoes those requesters immediately — an invalid
+        block from a punished peer means its other queued blocks are
+        suspect too)."""
         p = self.peers.pop(peer_id, None)
         if p is None:
             return []
@@ -65,7 +69,10 @@ class Scheduler:
             if owner == peer_id:
                 del self.pending[h]
                 freed.append(h)
-        # received-but-unprocessed blocks from this peer stay usable
+        for h, owner in list(self.received.items()):
+            if owner == peer_id:
+                del self.received[h]
+                freed.append(h)
         return freed
 
     # -- block events ------------------------------------------------------
@@ -96,13 +103,15 @@ class Scheduler:
         self.received.pop(height, None)
         self.height += 1
 
-    def block_invalid(self, height: int) -> Optional[str]:
-        """Verification failed: requeue from someone else; returns the peer
-        to punish."""
+    def block_invalid(self, height: int) -> Tuple[Optional[str], List[int]]:
+        """Verification failed: requeue from someone else.  Returns (peer to
+        punish, all heights freed for re-request — including the peer's
+        other received-but-unprocessed deliveries, which are now suspect)."""
         peer = self.received.pop(height, None)
+        freed = [height]
         if peer is not None:
-            self.remove_peer(peer)
-        return peer
+            freed.extend(self.remove_peer(peer))
+        return peer, freed
 
     # -- scheduling --------------------------------------------------------
     def max_peer_height(self) -> int:

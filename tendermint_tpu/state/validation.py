@@ -131,8 +131,13 @@ def verify_evidence(state: State, evidence, state_store=None) -> None:
     if state_store is not None:
         valset = state_store.load_validators(evidence.height())
     if valset is None:
-        # best effort: unchanged validator sets fall back to the current one
-        valset = state.validators
+        # The reference errors here (state/validation.go evidence path):
+        # validating against the wrong-era set would accept equivocation by
+        # someone who was not a validator at evidence.height, or reject
+        # evidence against someone who was.
+        raise ValueError(
+            f"no validator set stored for evidence height {evidence.height()}"
+        )
     _, val = valset.get_by_address(evidence.address())
     if val is None:
         raise ValueError(

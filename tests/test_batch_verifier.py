@@ -214,6 +214,28 @@ class TestPallasKernel:
         want = [em.verify(pk, m, sg) for pk, m, sg in zip(pubkeys, msgs, mutated)]
         assert got == want
 
+    def test_multi_tile_grid_interpret(self):
+        """tile < batch exercises the BlockSpec index maps with grid > 1 —
+        a multi-tile indexing bug must surface off-TPU, not only on real
+        hardware."""
+        import numpy as np
+
+        from tendermint_tpu.crypto.batch_verifier import prepare_batch
+        from tendermint_tpu.ops.ed25519_pallas import verify_prepared_pallas
+
+        pubkeys, msgs, sigs = make_sigs(8)
+        bad = bytearray(sigs[5])
+        bad[3] ^= 0x40  # corrupt one sig so tiles differ in outcome
+        sigs = sigs[:5] + [bytes(bad)] + sigs[6:]
+        neg_a, h, s, ry, rs, valid = prepare_batch(pubkeys, msgs, sigs)
+        ok = np.asarray(
+            verify_prepared_pallas(neg_a, h, s, ry, rs, tile=4, interpret=True)
+        )
+        got = list(np.logical_and(ok, valid))
+        want = [em.verify(pk, m, sg) for pk, m, sg in zip(pubkeys, msgs, sigs)]
+        assert got == want
+        assert got[5] is np.False_ or got[5] == False  # noqa: E712
+
 
 class TestPubkeyTable:
     def test_verify_indexed(self, verifier):

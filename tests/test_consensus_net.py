@@ -146,6 +146,45 @@ class TestConsensusNet:
             await stop_net(nodes)
 
 
+class TestByzantineResilience:
+    async def test_unwanted_round_vote_storm_does_not_halt(self, tmp_path):
+        """A peer spraying validly-signed votes across 3+ future rounds used
+        to raise GotVoteFromUnwantedRoundError out of the receive loop and
+        permanently halt the node (round-1 advisor high finding).  The storm
+        must be treated as peer misbehaviour; the net keeps committing."""
+        import time as _time
+
+        from tendermint_tpu.types import BlockID, Vote
+        from tendermint_tpu.types.canonical import PREVOTE_TYPE
+
+        nodes, pvs = await make_net(tmp_path, 4, name="storm")
+        try:
+            await wait_all_height(nodes, 2)
+            target = nodes[1]
+            attacker = pvs[0]
+            h = target.consensus.rs.height
+            # rounds 3 and 4 consume the two allowed catchup rounds for this
+            # peer; round 5 raises GotVoteFromUnwantedRoundError inside the
+            # serialized receive loop
+            for r in (3, 4, 5):
+                v = Vote(
+                    type=PREVOTE_TYPE,
+                    height=h,
+                    round=r,
+                    block_id=BlockID(),
+                    timestamp_ns=_time.time_ns(),
+                    validator_address=attacker.address(),
+                    validator_index=0,
+                )
+                attacker.sign_vote(CHAIN_ID, v)
+                await target.consensus.add_vote_input(v, peer_id="evil-peer")
+            before = target.block_store.height()
+            await wait_all_height(nodes, before + 2)
+            assert target.consensus.is_running
+        finally:
+            await stop_net(nodes)
+
+
 class TestByzantineEvidence:
     async def test_double_sign_evidence_committed(self, tmp_path):
         """A validator double-signs; the conflict is detected, evidence
