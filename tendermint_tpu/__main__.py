@@ -1,0 +1,7 @@
+"""`python -m tendermint_tpu` — the CLI binary (cmd/tendermint/main.go)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,302 @@
+"""Command-line interface.
+
+Reference parity: cmd/tendermint/main.go:16-45 (init, node/run, testnet,
+replay, replay_console, gen_validator, gen_node_key, show_validator,
+show_node_id, unsafe_reset_all, version) and commands/testnet.go (the
+N-validator config-tree generator powering the localnet harness).
+
+argparse plays cobra's role; `python -m tendermint_tpu <cmd>` is the
+binary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+from .config import Config, load_config, save_config
+from .types import GenesisDoc, GenesisValidator
+
+
+def _load_cfg(home: str) -> Config:
+    path = os.path.join(os.path.expanduser(home), "config", "config.toml")
+    if os.path.exists(path):
+        return load_config(path, home=home)
+    return Config(home=home)
+
+
+def _write_cfg(cfg: Config) -> None:
+    cfg.ensure_dirs()
+    save_config(cfg, os.path.join(os.path.expanduser(cfg.home), "config", "config.toml"))
+
+
+# -- commands ---------------------------------------------------------------
+
+
+def cmd_init(args) -> int:
+    """commands/init.go — config.toml, genesis with this node as the sole
+    validator, priv_validator key/state, node key."""
+    from .p2p.key import NodeKey
+    from .privval.file import load_or_gen_file_pv
+
+    cfg = Config(home=args.home)
+    cfg.base.chain_id = args.chain_id or f"test-chain-{os.urandom(3).hex()}"
+    _write_cfg(cfg)
+    pv = load_or_gen_file_pv(cfg)
+    NodeKey.load_or_gen(cfg.node_key_file())
+    gen_file = cfg.genesis_file()
+    if not os.path.exists(gen_file):
+        gen = GenesisDoc(
+            chain_id=cfg.base.chain_id,
+            genesis_time_ns=time.time_ns(),
+            validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10)],
+        )
+        gen.save_as(gen_file)
+    print(f"Initialized node in {cfg.home} (chain_id={cfg.base.chain_id})")
+    return 0
+
+
+def cmd_run(args) -> int:
+    """commands/run_node.go:97 — run a node until SIGINT/SIGTERM."""
+    from .node import default_new_node
+
+    cfg = _load_cfg(args.home)
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    cfg.validate_basic()
+    node = default_new_node(cfg)
+
+    async def _main() -> None:
+        loop = asyncio.get_event_loop()
+        stop = asyncio.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # pragma: no cover — non-unix
+                pass
+        await node.start()
+        print(f"node started: chain={node.genesis_doc.chain_id}", flush=True)
+        await stop.wait()
+        await node.stop()
+
+    asyncio.run(_main())
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """commands/testnet.go — an N-validator config tree under --output;
+    every node lists every other as a persistent peer (the docker-compose
+    localnet topology on localhost ports)."""
+    from .p2p.key import NodeKey
+    from .privval.file import load_or_gen_file_pv
+
+    n = args.validators
+    out = os.path.abspath(args.output)
+    chain_id = args.chain_id or f"testnet-{os.urandom(3).hex()}"
+    homes, pvs, node_keys = [], [], []
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        cfg = Config(home=home)
+        cfg.base.chain_id = chain_id
+        cfg.ensure_dirs()
+        pvs.append(load_or_gen_file_pv(cfg))
+        node_keys.append(NodeKey.load_or_gen(cfg.node_key_file()))
+        homes.append(home)
+
+    genesis = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=time.time_ns(),
+        validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
+    )
+    base_port = args.base_port
+    for i, home in enumerate(homes):
+        cfg = Config(home=home)
+        cfg.base.chain_id = chain_id
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{base_port + 10 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{base_port + 10 * i + 1}"
+        cfg.p2p.persistent_peers = ",".join(
+            f"{node_keys[j].id}@127.0.0.1:{base_port + 10 * j}" for j in range(n) if j != i
+        )
+        cfg.p2p.allow_duplicate_ip = True
+        _write_cfg(cfg)
+        genesis.save_as(cfg.genesis_file())
+    print(f"Successfully initialized {n} node directories in {out} (chain_id={chain_id})")
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    """commands/gen_validator.go — print a fresh FilePV key as JSON."""
+    from .crypto.keys import Ed25519PrivKey
+
+    priv = Ed25519PrivKey.generate()
+    print(
+        json.dumps(
+            {
+                "address": priv.pub_key().address().hex().upper(),
+                "pub_key": {"type": priv.pub_key().TYPE, "value": priv.pub_key().bytes().hex()},
+                "priv_key": {"type": priv.TYPE, "value": priv.bytes().hex()},
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    from .p2p.key import NodeKey
+
+    cfg = Config(home=args.home)
+    cfg.ensure_dirs()
+    nk = NodeKey.load_or_gen(cfg.node_key_file())
+    print(nk.id)
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from .p2p.key import NodeKey
+
+    cfg = _load_cfg(args.home)
+    path = cfg.node_key_file()
+    if not os.path.exists(path):
+        print("node key not found; run `init` first", file=sys.stderr)
+        return 1
+    print(NodeKey.load(path).id)
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from .privval.file import FilePV
+
+    cfg = _load_cfg(args.home)
+    if not os.path.exists(cfg.priv_validator_key_file()):
+        print("priv_validator key not found; run `init` first", file=sys.stderr)
+        return 1
+    pv = FilePV.load(cfg.priv_validator_key_file(), cfg.priv_validator_state_file())
+    pub = pv.get_pub_key()
+    print(json.dumps({"type": pub.TYPE, "value": pub.bytes().hex()}))
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """commands/reset_priv_validator.go — wipe data, keep keys."""
+    cfg = _load_cfg(args.home)
+    data = cfg.db_dir()
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+    os.makedirs(data, exist_ok=True)
+    # reset the last-sign state (fresh chain ⇒ heights restart)
+    state_file = cfg.priv_validator_state_file()
+    if os.path.exists(state_file):
+        os.unlink(state_file)
+    print(f"Reset {data}")
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """commands/replay.go — replay the WAL through a fresh consensus state
+    (console mode steps interactively)."""
+    from .consensus.replay_file import run_replay_file
+
+    cfg = _load_cfg(args.home)
+    asyncio.run(run_replay_file(cfg, console=args.console))
+    return 0
+
+
+def cmd_light(args) -> int:
+    """commands/lite.go — run a light-client proxy against a primary."""
+    from .lite2.proxy import run_proxy
+
+    asyncio.run(
+        run_proxy(
+            chain_id=args.chain_id,
+            primary_addr=args.primary,
+            witness_addrs=[w for w in (args.witnesses or "").split(",") if w],
+            laddr=args.laddr,
+            trust_height=args.height,
+            trust_hash=bytes.fromhex(args.hash),
+            trusting_period_s=args.trusting_period,
+        )
+    )
+    return 0
+
+
+def cmd_version(args) -> int:
+    from . import version
+
+    print(version.VERSION)
+    return 0
+
+
+# -- parser -----------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tendermint_tpu", description="TPU-native BFT state-machine replication engine"
+    )
+    p.add_argument("--home", default=os.environ.get("TMHOME", "~/.tendermint_tpu"))
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="initialize a home directory")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("node", aliases=["run", "start"], help="run a node")
+    sp.add_argument("--proxy-app", default="")
+    sp.set_defaults(fn=cmd_run)
+
+    sp = sub.add_parser("testnet", help="generate an N-validator testnet config tree")
+    sp.add_argument("--validators", "-v", type=int, default=4)
+    sp.add_argument("--output", "-o", default="./mytestnet")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--base-port", type=int, default=26656)
+    sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("gen_validator", help="generate a validator keypair")
+    sp.set_defaults(fn=cmd_gen_validator)
+
+    sp = sub.add_parser("gen_node_key", help="generate (or show) the node key")
+    sp.set_defaults(fn=cmd_gen_node_key)
+
+    sp = sub.add_parser("show_node_id", help="show this node's p2p ID")
+    sp.set_defaults(fn=cmd_show_node_id)
+
+    sp = sub.add_parser("show_validator", help="show this node's validator pubkey")
+    sp.set_defaults(fn=cmd_show_validator)
+
+    sp = sub.add_parser("unsafe_reset_all", help="wipe blockchain data (keeps keys)")
+    sp.set_defaults(fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("replay", help="replay the consensus WAL")
+    sp.add_argument("--console", action="store_true", help="step interactively")
+    sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("light", help="run a verifying light-client RPC proxy")
+    sp.add_argument("--chain-id", required=True)
+    sp.add_argument("--primary", required=True, help="primary node RPC address")
+    sp.add_argument("--witnesses", default="", help="comma-separated witness RPC addresses")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.add_argument("--height", type=int, required=True, help="trusted height")
+    sp.add_argument("--hash", required=True, help="trusted header hash (hex)")
+    sp.add_argument("--trusting-period", type=float, default=168 * 3600)
+    sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("version", help="print version")
+    sp.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

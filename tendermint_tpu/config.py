@@ -135,6 +135,21 @@ class ConsensusConfig:
 
 
 @dataclass
+class TPUConfig:
+    """The batch-verify engine (no reference counterpart — the north star).
+
+    With `enabled`, node startup builds a BatchVerifier, installs it as the
+    process-wide crypto.batch hook (so verify_commit / fastsync replay /
+    lite2 hit the device path) and runs an AsyncBatchVerifier feeding the
+    consensus reactor's vote ingress."""
+
+    enabled: bool = True
+    flush_interval: float = 0.002  # async batcher deadline (seconds)
+    max_batch: int = 4096
+    mesh_devices: int = 0  # 0 = single device; N>1 shards the batch axis
+
+
+@dataclass
 class TxIndexConfig:
     indexer: str = "kv"  # kv | null
 
@@ -156,6 +171,7 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     fast_sync: FastSyncConfig = field(default_factory=FastSyncConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    tpu: TPUConfig = field(default_factory=TPUConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
 
@@ -230,6 +246,9 @@ def test_config(home: str) -> Config:
     )
     cfg.base.fast_sync = False
     cfg.p2p.laddr = ""  # tests opt into p2p with an explicit 127.0.0.1:0
+    # host verify is faster than XLA compiles at test scale; engine tests
+    # turn the device path back on explicitly
+    cfg.tpu.enabled = False
     return cfg
 
 
@@ -248,6 +267,7 @@ def save_config(cfg: Config, path: str) -> None:
         "mempool": cfg.mempool,
         "fastsync": cfg.fast_sync,
         "consensus": cfg.consensus,
+        "tpu": cfg.tpu,
         "tx_index": cfg.tx_index,
         "instrumentation": cfg.instrumentation,
     }
@@ -289,6 +309,7 @@ def load_config(path: str, home: Optional[str] = None) -> Config:
     apply(cfg.mempool, data.get("mempool", {}))
     apply(cfg.fast_sync, data.get("fastsync", {}))
     apply(cfg.consensus, data.get("consensus", {}))
+    apply(cfg.tpu, data.get("tpu", {}))
     apply(cfg.tx_index, data.get("tx_index", {}))
     apply(cfg.instrumentation, data.get("instrumentation", {}))
     return cfg

@@ -409,6 +409,11 @@ class ConsensusReactor(Reactor):
                         }))
                         if ok:
                             ps.set_has_proposal_block_part(ps.height, ps.round, idx)
+                            continue
+                        # send refused (mconn stopping / unknown channel):
+                        # returning False does NOT yield, so looping here
+                        # would busy-spin and starve the event loop
+                        await asyncio.sleep(sleep)
                         continue
             # 2. peer is catching up: send parts of their next stored block
             if 0 < ps.height < rs.height and ps.height >= self.cs.block_store.base():
@@ -419,7 +424,12 @@ class ConsensusReactor(Reactor):
             # 3. send the proposal (+POL) if the peer lacks it
             if rs.proposal is not None and rs.height == ps.height and not ps.proposal:
                 if rs.round == ps.round:
-                    await peer.send(DATA_CHANNEL, _enc("proposal", {"proposal": rs.proposal.to_dict()}))
+                    ok = await peer.send(
+                        DATA_CHANNEL, _enc("proposal", {"proposal": rs.proposal.to_dict()})
+                    )
+                    if not ok:
+                        await asyncio.sleep(sleep)
+                        continue
                     ps.set_has_proposal(rs.proposal)
                     if 0 <= rs.proposal.pol_round:
                         pol = rs.votes.prevotes(rs.proposal.pol_round)

@@ -64,6 +64,16 @@ def _vote_to_wire(vote: Vote) -> dict:
     return vote.to_dict()
 
 
+async def _maybe_await(x):
+    """PrivValidator impls may be sync (FilePV/MockPV) or async (the remote
+    SignerClient, privval/signer_client.go) — tolerate both."""
+    import inspect
+
+    if inspect.isawaitable(x):
+        return await x
+    return x
+
+
 class ConsensusState(Service):
     def __init__(
         self,
@@ -141,9 +151,19 @@ class ConsensusState(Service):
     async def on_start(self) -> None:
         await self.timeout_ticker.start()
         if self.do_wal_catchup and not isinstance(self.wal, NilWAL):
+            from ..consensus.wal import WALCorruptionError
             from .replay import catchup_replay
 
-            await catchup_replay(self, self.rs.height)
+            try:
+                await catchup_replay(self, self.rs.height)
+            except WALCorruptionError:
+                self.log.error("corrupt WAL file; repair it before restarting")
+                raise
+            except Exception as e:
+                # state.go:328 — e.g. a crash between save_block and the
+                # ENDHEIGHT marker leaves the WAL one marker short; the
+                # handshake already replayed the block, so proceed.
+                self.log.error("error on catchup replay; proceeding to start anyway", err=repr(e))
         self._ticker_pump = self.spawn(self._pump_timeouts(), "ticker-pump")
         if self.mempool.txs_available() is not None:
             self._txs_pump = self.spawn(self._pump_txs_available(), "txs-pump")
@@ -405,7 +425,7 @@ class ConsensusState(Service):
             timestamp_ns=time.time_ns(),
         )
         try:
-            self.priv_validator.sign_proposal(self.sm_state.chain_id, proposal)
+            await _maybe_await(self.priv_validator.sign_proposal(self.sm_state.chain_id, proposal))
         except Exception as e:
             if not self.replay_mode:
                 self.log.error("error signing proposal", height=height, round=round_, err=str(e))
@@ -468,18 +488,18 @@ class ConsensusState(Service):
         """state.go:1093."""
         rs = self.rs
         if rs.locked_block is not None:
-            self._sign_add_vote(PREVOTE_TYPE, rs.locked_block.hash(), rs.locked_block_parts.header())
+            await self._sign_add_vote(PREVOTE_TYPE, rs.locked_block.hash(), rs.locked_block_parts.header())
             return
         if rs.proposal_block is None:
-            self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+            await self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
             return
         try:
             self.block_exec.validate_block(self.sm_state, rs.proposal_block)
         except Exception as e:
             self.log.error("prevote: ProposalBlock is invalid", err=str(e))
-            self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+            await self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
             return
-        self._sign_add_vote(
+        await self._sign_add_vote(
             PREVOTE_TYPE, rs.proposal_block.hash(), rs.proposal_block_parts.header()
         )
 
@@ -512,7 +532,7 @@ class ConsensusState(Service):
 
             if not ok:
                 # no polka: precommit nil
-                self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+                await self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
                 return
 
             if self.event_bus:
@@ -530,7 +550,7 @@ class ConsensusState(Service):
                     rs.locked_block_parts = None
                     if self.event_bus:
                         await self.event_bus.publish_unlock(rs.event_dict())
-                self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+                await self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
                 return
 
             if rs.locked_block is not None and rs.locked_block.hashes_to(block_id.hash):
@@ -538,7 +558,7 @@ class ConsensusState(Service):
                 rs.locked_round = round_
                 if self.event_bus:
                     await self.event_bus.publish_relock(rs.event_dict())
-                self._sign_add_vote(PRECOMMIT_TYPE, block_id.hash, block_id.parts_header)
+                await self._sign_add_vote(PRECOMMIT_TYPE, block_id.hash, block_id.parts_header)
                 return
 
             if rs.proposal_block is not None and rs.proposal_block.hashes_to(block_id.hash):
@@ -549,7 +569,7 @@ class ConsensusState(Service):
                 rs.locked_block_parts = rs.proposal_block_parts
                 if self.event_bus:
                     await self.event_bus.publish_lock(rs.event_dict())
-                self._sign_add_vote(PRECOMMIT_TYPE, block_id.hash, block_id.parts_header)
+                await self._sign_add_vote(PRECOMMIT_TYPE, block_id.hash, block_id.parts_header)
                 return
 
             # polka for a block we don't have: unlock, fetch, precommit nil
@@ -563,7 +583,7 @@ class ConsensusState(Service):
                 rs.proposal_block_parts = PartSet.from_header(block_id.parts_header)
             if self.event_bus:
                 await self.event_bus.publish_unlock(rs.event_dict())
-            self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+            await self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
         finally:
             self._update_round_step(round_, RoundStep.PRECOMMIT)
             await self._new_step()
@@ -888,7 +908,7 @@ class ConsensusState(Service):
             cb(vote)
 
     # -- signing -----------------------------------------------------------
-    def _sign_vote(self, msg_type: int, hash_: bytes, header: PartSetHeader) -> Vote:
+    async def _sign_vote(self, msg_type: int, hash_: bytes, header: PartSetHeader) -> Vote:
         """state.go:1922."""
         self.wal.flush_and_sync()
         pub_key = self.priv_validator.get_pub_key()
@@ -903,7 +923,7 @@ class ConsensusState(Service):
             validator_address=addr,
             validator_index=val_idx,
         )
-        self.priv_validator.sign_vote(self.sm_state.chain_id, vote)
+        await _maybe_await(self.priv_validator.sign_vote(self.sm_state.chain_id, vote))
         return vote
 
     def _vote_time(self) -> int:
@@ -917,7 +937,7 @@ class ConsensusState(Service):
             min_time = self.rs.proposal_block.time_ns + iota_ns
         return max(now, min_time)
 
-    def _sign_add_vote(self, msg_type: int, hash_: bytes, header: PartSetHeader) -> Optional[Vote]:
+    async def _sign_add_vote(self, msg_type: int, hash_: bytes, header: PartSetHeader) -> Optional[Vote]:
         """state.go:1961."""
         if self.priv_validator is None:
             return None
@@ -925,7 +945,7 @@ class ConsensusState(Service):
         if not self.rs.validators.has_address(pub_key.address()):
             return None
         try:
-            vote = self._sign_vote(msg_type, hash_, header)
+            vote = await self._sign_vote(msg_type, hash_, header)
         except Exception as e:
             if not self.replay_mode:
                 self.log.error("error signing vote", err=str(e))

@@ -97,15 +97,9 @@ def _msb_digits(values_le: np.ndarray) -> np.ndarray:
 
 def _r_limbs_and_sign(r_bytes: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """[B, 32] little-endian R rows -> raw y limbs [B, 20] + sign bit [B]."""
-    bits = np.unpackbits(r_bytes, axis=1, bitorder="little")
-    sign = bits[:, 255].copy()  # uint8
-    y_bits = bits[:, :255].astype(np.int16)
-    pow2 = (1 << np.arange(_LIMB_BITS)).astype(np.int16)
-    limbs = np.zeros((r_bytes.shape[0], _N_LIMBS), dtype=np.int16)
-    for j in range(_N_LIMBS):
-        chunk = y_bits[:, j * _LIMB_BITS : (j + 1) * _LIMB_BITS]
-        limbs[:, j] = chunk @ pow2[: chunk.shape[1]]
-    return limbs, sign
+    from . import hostprep
+
+    return hostprep.limbs_from_le_bytes(r_bytes), hostprep.sign_bits(r_bytes)
 
 
 def _scalar_rows(
@@ -115,27 +109,38 @@ def _scalar_rows(
     canonical-S / length prefilters.  `items[i]` is (pubkey, msg, sig) or
     None when the caller already knows entry i is invalid.  Returns
     (h_digits, s_digits, r_y_raw, r_sign, valid)."""
+    from . import hostprep
+
     n = len(items)
     valid = np.zeros(n, dtype=bool)
     zeros32 = bytes(32)
-    h_parts: list = [zeros32] * n
     s_parts: list = [zeros32] * n
     r_parts: list = [zeros32] * n
+    hash_parts: list = []
+    hash_pos: list = []
     for i, item in enumerate(items):
         if item is None:
             continue
         pk, msg, sig = item
-        if len(sig) != 64 or not em.sc_minimal(sig[32:]):
+        if len(sig) != 64:
             continue
-        h = em.compute_hram(sig[:32], pk, msg)
-        h_parts[i] = h.to_bytes(32, "little")
         s_parts[i] = sig[32:]
         r_parts[i] = sig[:32]
+        hash_parts.append(sig[:32] + pk + msg)
+        hash_pos.append(i)
         valid[i] = True
     # one frombuffer per column instead of 3n row-wise assignments
-    h_le = np.frombuffer(b"".join(h_parts), dtype=np.uint8).reshape(n, 32)
     s_le = np.frombuffer(b"".join(s_parts), dtype=np.uint8).reshape(n, 32)
     r_le = np.frombuffer(b"".join(r_parts), dtype=np.uint8).reshape(n, 32)
+    # canonical-S prefilter, vectorized (was a per-item bigint compare)
+    valid &= hostprep.sc_minimal_rows(s_le)
+    # batch SHA-512 h = H(R‖A‖M) via the C extension + mod-L reduce
+    h_parts: list = [zeros32] * n
+    if hash_parts:
+        digests = hostprep.sha512_batch(hash_parts)
+        for pos, hb in zip(hash_pos, hostprep.reduce_mod_l(digests)):
+            h_parts[pos] = hb
+    h_le = np.frombuffer(b"".join(h_parts), dtype=np.uint8).reshape(n, 32)
     r_y_raw, r_sign = _r_limbs_and_sign(r_le)
     return _msb_digits(h_le), _msb_digits(s_le), r_y_raw, r_sign, valid
 

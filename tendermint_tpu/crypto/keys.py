@@ -277,6 +277,10 @@ def pubkey_from_dict(d: dict) -> PubKey:
     for cls in (Ed25519PubKey, Secp256k1PubKey):
         if t == cls.TYPE:
             return cls(d["value"])
+    from .sr25519 import Sr25519PubKey  # cyclic at import time
+
+    if t == Sr25519PubKey.TYPE:
+        return Sr25519PubKey(d["value"])
     from .multisig import MultisigThresholdPubKey  # cyclic at import time
 
     if t == MultisigThresholdPubKey.TYPE:

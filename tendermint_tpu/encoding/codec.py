@@ -34,6 +34,16 @@ def register(tag: str) -> Callable[[Type], Type]:
     return deco
 
 
+def tag_for(cls: Type) -> str | None:
+    """The wire tag a class registered under, or None."""
+    return _TAGS.get(cls)
+
+
+def class_for(tag: str) -> Type | None:
+    """The class registered under a wire tag, or None."""
+    return _REGISTRY.get(tag)
+
+
 def _default(obj: Any) -> Any:
     tag = _TAGS.get(type(obj))
     if tag is not None:

@@ -84,11 +84,15 @@ def verify_commit_run(
             if cs.is_absent():
                 continue
             idxs.append((pi, i))
-            pubkeys.append(val_set.validators[i].pub_key.bytes())
+            pubkeys.append(val_set.validators[i].pub_key)
             msgs.append(commit.vote_sign_bytes(chain_id, i))
             sigs.append(cs.signature)
 
-    ok = crypto_batch.get_verifier()(pubkeys, msgs, sigs)
+    # type-routed: ed25519 rides the batch engine, other key types verify
+    # via their own PubKey.verify (same dispatch as ValidatorSet.verify_commit)
+    from ..types.validator import mixed_batch_verify
+
+    ok = mixed_batch_verify(pubkeys, msgs, sigs)
 
     tallied = [0] * len(pairs)
     sig_ok = [True] * len(pairs)

@@ -64,20 +64,27 @@ class Service:
         self.logger.debug("service starting")
         await self.on_start()
 
+    # Stop must terminate even if a task or an on_stop override misbehaves:
+    # a wedged child must never deadlock the whole shutdown tree (the
+    # reference's BaseService.Stop is likewise non-blocking on Quit).
+    STOP_TIMEOUT = 10.0
+
     async def stop(self) -> None:
         if self._stopped:
             return
         self._stopped = True
         self.logger.debug("service stopping")
         try:
-            await self.on_stop()
+            await asyncio.wait_for(self.on_stop(), self.STOP_TIMEOUT)
+        except asyncio.TimeoutError:
+            self.logger.error("on_stop timed out after %.0fs; forcing", self.STOP_TIMEOUT)
         finally:
             for t in self._tasks:
                 t.cancel()
-            for t in self._tasks:
+            for t in list(self._tasks):
                 try:
-                    await t
-                except (asyncio.CancelledError, Exception):
+                    await asyncio.wait_for(t, self.STOP_TIMEOUT)
+                except (asyncio.CancelledError, asyncio.TimeoutError, Exception):
                     pass
             self._tasks.clear()
             if self._quit is not None:

@@ -1,0 +1,33 @@
+"""Light client (reference: lite2/) on the TPU batch verifier.
+
+The verification core is ValidatorSet.verify_commit /
+verify_commit_trusting (types/validator.py), which route every signature
+batch through crypto.batch.get_verifier() — so a light client syncing a
+100-validator chain verifies each header's commit as ONE device batch
+(BASELINE config #4, TPU batch target #4 in SURVEY §3.5).
+"""
+
+from .client import (  # noqa: F401
+    BISECTION,
+    SEQUENCE,
+    Client,
+    DivergedHeaderError,
+    LightClientError,
+    TrustOptions,
+)
+from .provider import (  # noqa: F401
+    HTTPProvider,
+    LocalProvider,
+    MockProvider,
+    Provider,
+    ProviderError,
+)
+from .store import DBStore, MemStore  # noqa: F401
+from .verifier import (  # noqa: F401
+    ErrNewValSetCantBeTrusted,
+    InvalidHeaderError,
+    header_expired,
+    verify,
+    verify_adjacent,
+    verify_non_adjacent,
+)

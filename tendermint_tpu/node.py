@@ -36,6 +36,23 @@ def only_validator_is_us(state, priv_val) -> bool:
     return addr == priv_val.get_pub_key().address()
 
 
+def default_new_node(config: Config, genesis_doc: Optional[GenesisDoc] = None) -> "Node":
+    """node/node.go:90 DefaultNewNode — genesis from the config tree, FilePV
+    (or a remote signer when priv_validator_laddr is set) for signing."""
+    if genesis_doc is None:
+        genesis_doc = GenesisDoc.from_file(config.genesis_file())
+    if config.base.priv_validator_laddr:
+        from .privval import SignerClient
+
+        pv = SignerClient(config.base.priv_validator_laddr)
+    else:
+        from .privval.file import load_or_gen_file_pv
+
+        config.ensure_dirs()
+        pv = load_or_gen_file_pv(config)
+    return Node(config, genesis_doc, priv_validator=pv)
+
+
 class Node(Service):
     def __init__(
         self,
@@ -77,9 +94,37 @@ class Node(Service):
         self.switch = None
         self.node_key = None
         self.rpc_server = None
+        self.batch_verifier = None
+        self.async_verifier = None
 
     async def on_start(self) -> None:
         cfg = self.config
+        # TPU batch-verify engine first: every downstream consumer of
+        # crypto.batch.get_verifier() (handshake replay, fastsync,
+        # verify_commit in block validation) must already see the device
+        # path.  This is the BASELINE north-star wiring: the node runs its
+        # own engine, not the serial host fallback.
+        if cfg.tpu.enabled:
+            from .crypto.batch_verifier import AsyncBatchVerifier, BatchVerifier
+
+            mesh = None
+            if cfg.tpu.mesh_devices > 1:
+                import jax
+                from jax.sharding import Mesh
+
+                devs = jax.devices()[: cfg.tpu.mesh_devices]
+                mesh = Mesh(devs, ("batch",))
+            self.batch_verifier = BatchVerifier(mesh=mesh).install()
+            self.async_verifier = AsyncBatchVerifier(
+                self.batch_verifier,
+                max_batch=cfg.tpu.max_batch,
+                flush_interval=cfg.tpu.flush_interval,
+            )
+            await self.async_verifier.start()
+        # remote signer: wait for the external signer to dial in BEFORE
+        # consensus needs a pubkey (node/node.go:612-618)
+        if isinstance(self.priv_validator, Service) and not self.priv_validator.is_running:
+            await self.priv_validator.start()
         await self.event_bus.start()
         await self.indexer_service.start()
         await self.proxy_app.start()
@@ -132,6 +177,7 @@ class Node(Service):
 
             self.rpc_server = RPCServer(self, cfg.rpc)
             await self.rpc_server.start()
+            self.log.info("rpc listening", laddr=cfg.rpc.laddr)
 
         # p2p stack + reactors (node/node.go:653-709)
         if cfg.p2p.laddr and cfg.p2p.laddr != "none":
@@ -157,7 +203,9 @@ class Node(Service):
             do_fast_sync = cfg.base.fast_sync and not only_validator_is_us(
                 self.state, self.priv_validator
             )
-            self.consensus_reactor = ConsensusReactor(self.consensus, wait_sync=do_fast_sync)
+            self.consensus_reactor = ConsensusReactor(
+                self.consensus, wait_sync=do_fast_sync, async_verifier=self.async_verifier
+            )
             self.blockchain_reactor = BlockchainReactor(
                 self.state,
                 block_exec,
@@ -197,3 +245,14 @@ class Node(Service):
         await self.indexer_service.stop()
         await self.event_bus.stop()
         await self.proxy_app.stop()
+        if isinstance(self.priv_validator, Service) and self.priv_validator.is_running:
+            await self.priv_validator.stop()
+        if self.async_verifier is not None:
+            await self.async_verifier.stop()
+        if self.batch_verifier is not None:
+            from .crypto import batch as batch_hook
+
+            # uninstall only if the process-wide hook is still ours — another
+            # live node may have installed its own engine meanwhile
+            if batch_hook.get_verifier() == self.batch_verifier.verify:
+                batch_hook.set_verifier(None)

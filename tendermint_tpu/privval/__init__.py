@@ -1,0 +1,5 @@
+"""Validator signing: file-backed PV with persisted double-sign protection
+and the remote-signer socket pair (reference: privval/)."""
+
+from .file import FilePV, FilePVKey, FilePVLastSignState  # noqa: F401
+from .signer import SignerClient, SignerServer  # noqa: F401

@@ -1,0 +1,216 @@
+"""Remote signer: the privval socket boundary.
+
+Reference parity: privval/signer_client.go:15 (SignerClient — the
+PrivValidator the node uses), signer_listener_endpoint.go (node listens on
+priv_validator_laddr, the signer dials IN), signer_dialer_endpoint.go +
+signer_server.go (the external signer process wrapping a FilePV),
+messages.go (SignVote/SignProposal/PubKey/Ping request-response pairs).
+
+Wire: 4-byte big-endian length + msgpack codec frames (Vote/Proposal are
+registered types).  The signer side is async end-to-end, so an in-process
+signer (tests) shares the node's event loop without deadlock — the reason
+ConsensusState awaits PrivValidator results via _maybe_await.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Optional, Tuple
+
+from ..crypto.keys import PubKey, pubkey_from_dict
+from ..encoding import codec
+from ..libs.log import get_logger
+from ..libs.service import Service
+from ..types.priv_validator import PrivValidator
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+
+
+class RemoteSignerError(Exception):
+    pass
+
+
+def _split_addr(addr: str) -> Tuple[str, int]:
+    addr = addr.split("://", 1)[-1]
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+async def _send_frame(writer: asyncio.StreamWriter, msg: dict) -> None:
+    payload = codec.dumps(msg)
+    writer.write(struct.pack(">I", len(payload)) + payload)
+    await writer.drain()
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> dict:
+    hdr = await reader.readexactly(4)
+    (n,) = struct.unpack(">I", hdr)
+    if n > 1 << 20:
+        raise RemoteSignerError(f"oversized privval frame ({n} bytes)")
+    return codec.loads(await reader.readexactly(n))
+
+
+class SignerClient(PrivValidator, Service):
+    """Node-side PrivValidator over the socket (privval/signer_client.go).
+
+    Listens on `laddr`; a SignerServer dials in.  `start()` blocks until
+    the signer connects and the pubkey is fetched (node startup needs it
+    synchronously afterwards, node/node.go:612-618).
+    """
+
+    def __init__(self, laddr: str, timeout: float = 5.0, accept_timeout: float = 30.0):
+        Service.__init__(self, "signer-client")
+        self.laddr = laddr
+        self.timeout = timeout
+        self.accept_timeout = accept_timeout
+        self.log = get_logger("privval.client")
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conn: Optional[Tuple[asyncio.StreamReader, asyncio.StreamWriter]] = None
+        self._conn_ready = asyncio.Event()
+        self._lock = asyncio.Lock()
+        self._pub_key: Optional[PubKey] = None
+        self.listen_addr: str = ""
+
+    async def on_start(self) -> None:
+        host, port = _split_addr(self.laddr)
+        self._server = await asyncio.start_server(self._on_accept, host, port)
+        sock = self._server.sockets[0]
+        self.listen_addr = "%s:%d" % sock.getsockname()[:2]
+        try:
+            await asyncio.wait_for(self._conn_ready.wait(), self.accept_timeout)
+        except asyncio.TimeoutError:
+            raise RemoteSignerError(f"no remote signer connected within {self.accept_timeout}s")
+        self._pub_key = await self._fetch_pub_key()
+
+    async def on_stop(self) -> None:
+        if self._conn is not None:
+            self._conn[1].close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _on_accept(self, reader, writer) -> None:
+        if self._conn is not None:  # signer reconnected: drop the old conn
+            self._conn[1].close()
+        self._conn = (reader, writer)
+        self._conn_ready.set()
+        self.log.info("remote signer connected")
+
+    async def _request(self, msg: dict) -> dict:
+        async with self._lock:
+            if self._conn is None:
+                raise RemoteSignerError("no signer connection")
+            reader, writer = self._conn
+            await _send_frame(writer, msg)
+            resp = await asyncio.wait_for(_read_frame(reader), self.timeout)
+        if resp.get("t") == "error":
+            raise RemoteSignerError(resp.get("err", "unknown remote signer error"))
+        return resp
+
+    async def _fetch_pub_key(self) -> PubKey:
+        resp = await self._request({"t": "pubkey_req"})
+        return pubkey_from_dict(resp["pubkey"])
+
+    async def ping(self) -> None:
+        await self._request({"t": "ping"})
+
+    # -- PrivValidator (async: ConsensusState awaits via _maybe_await) -----
+
+    def get_pub_key(self) -> PubKey:
+        if self._pub_key is None:
+            raise RemoteSignerError("signer client not started")
+        return self._pub_key
+
+    def address(self) -> bytes:
+        return self.get_pub_key().address()
+
+    async def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        resp = await self._request({"t": "sign_vote_req", "chain_id": chain_id, "vote": vote})
+        signed: Vote = resp["vote"]
+        vote.signature = signed.signature
+        vote.timestamp_ns = signed.timestamp_ns  # timestamp-only re-sign case
+
+    async def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        resp = await self._request(
+            {"t": "sign_proposal_req", "chain_id": chain_id, "proposal": proposal}
+        )
+        signed: Proposal = resp["proposal"]
+        proposal.signature = signed.signature
+        proposal.timestamp_ns = signed.timestamp_ns
+
+
+class SignerServer(Service):
+    """Signer-side: wraps a local PrivValidator (normally FilePV), dials
+    the node, serves sign requests (privval/signer_server.go + dialer
+    endpoint retry loop)."""
+
+    def __init__(
+        self,
+        laddr: str,
+        priv_validator: PrivValidator,
+        retries: int = 10,
+        retry_interval: float = 0.5,
+    ):
+        super().__init__("signer-server")
+        self.laddr = laddr
+        self.pv = priv_validator
+        self.retries = retries
+        self.retry_interval = retry_interval
+        self.log = get_logger("privval.server")
+        self._task: Optional[asyncio.Task] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+
+    async def on_start(self) -> None:
+        host, port = _split_addr(self.laddr)
+        last_err: Optional[Exception] = None
+        for _ in range(self.retries):
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+                break
+            except OSError as e:
+                last_err = e
+                await asyncio.sleep(self.retry_interval)
+        else:
+            raise RemoteSignerError(f"cannot dial {self.laddr}: {last_err}")
+        self._writer = writer
+        self._task = asyncio.create_task(self._serve(reader, writer))
+
+    async def on_stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self._writer is not None:
+            self._writer.close()
+
+    async def _serve(self, reader, writer) -> None:
+        while True:
+            try:
+                req = await _read_frame(reader)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                self.log.info("node connection closed")
+                return
+            try:
+                resp = self._handle(req)
+            except Exception as e:  # double-sign refusals travel as errors
+                resp = {"t": "error", "err": str(e)}
+            await _send_frame(writer, resp)
+
+    def _handle(self, req: dict) -> dict:
+        kind = req.get("t")
+        if kind == "ping":
+            return {"t": "pong"}
+        if kind == "pubkey_req":
+            return {"t": "pubkey_resp", "pubkey": self.pv.get_pub_key().to_dict()}
+        if kind == "sign_vote_req":
+            vote: Vote = req["vote"]
+            self.pv.sign_vote(req["chain_id"], vote)
+            return {"t": "signed_vote_resp", "vote": vote}
+        if kind == "sign_proposal_req":
+            proposal: Proposal = req["proposal"]
+            self.pv.sign_proposal(req["chain_id"], proposal)
+            return {"t": "signed_proposal_resp", "proposal": proposal}
+        raise RemoteSignerError(f"unknown privval request {kind!r}")

@@ -1,0 +1,12 @@
+"""JSON-RPC API layer (reference: rpc/).
+
+- jsonrpc: envelope + JSON-safe codec for domain types
+- core:    route handlers reading node internals (rpc/core/routes.go:10-56)
+- server:  HTTP + WebSocket server (rpc/lib/server/)
+- client:  HTTP / WS / in-proc Local clients (rpc/client/, rpc/lib/client/)
+"""
+
+from .client import HTTPClient, LocalClient, WSClient  # noqa: F401
+from .core import RPCCore  # noqa: F401
+from .jsonrpc import RPCError, from_jsonable, to_jsonable  # noqa: F401
+from .server import RPCServer  # noqa: F401

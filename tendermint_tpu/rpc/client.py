@@ -1,0 +1,274 @@
+"""RPC clients: HTTP, WebSocket, and in-proc Local.
+
+Reference parity: rpc/client/http (HTTPClient), rpc/lib/client/ws_client.go
+(WSClient with request/response correlation + event delivery),
+rpc/client/local (Local wraps the node directly — used by lite2's provider
+and tests).  All three expose the same method surface so callers (lite2,
+CLI, tests) are transport-agnostic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, AsyncIterator, Dict, Optional
+
+import aiohttp
+
+from .core import RPCCore
+from .jsonrpc import RPCError, from_jsonable, make_request, parse_response
+
+
+class BaseClient:
+    """Route methods shared by every transport; subclasses implement
+    `_call(method, params)`."""
+
+    async def _call(self, method: str, params: Optional[dict] = None) -> Any:
+        raise NotImplementedError
+
+    # info
+    async def health(self):
+        return await self._call("health")
+
+    async def status(self):
+        return await self._call("status")
+
+    async def net_info(self):
+        return await self._call("net_info")
+
+    async def genesis(self):
+        return await self._call("genesis")
+
+    # blocks
+    async def blockchain(self, min_height: int = 0, max_height: int = 0):
+        return await self._call("blockchain", {"min_height": min_height, "max_height": max_height})
+
+    async def block(self, height: Optional[int] = None):
+        return await self._call("block", {} if height is None else {"height": height})
+
+    async def block_by_hash(self, hash: bytes):  # noqa: A002
+        return await self._call("block_by_hash", {"hash": hash})
+
+    async def block_results(self, height: Optional[int] = None):
+        return await self._call("block_results", {} if height is None else {"height": height})
+
+    async def commit(self, height: Optional[int] = None):
+        return await self._call("commit", {} if height is None else {"height": height})
+
+    async def validators(self, height: Optional[int] = None, page: int = 1, per_page: int = 30):
+        params: Dict[str, Any] = {"page": page, "per_page": per_page}
+        if height is not None:
+            params["height"] = height
+        return await self._call("validators", params)
+
+    async def consensus_params(self, height: Optional[int] = None):
+        return await self._call("consensus_params", {} if height is None else {"height": height})
+
+    async def consensus_state(self):
+        return await self._call("consensus_state")
+
+    async def dump_consensus_state(self):
+        return await self._call("dump_consensus_state")
+
+    # mempool / txs
+    async def unconfirmed_txs(self, limit: int = 30):
+        return await self._call("unconfirmed_txs", {"limit": limit})
+
+    async def num_unconfirmed_txs(self):
+        return await self._call("num_unconfirmed_txs")
+
+    async def broadcast_tx_async(self, tx: bytes):
+        return await self._call("broadcast_tx_async", {"tx": tx})
+
+    async def broadcast_tx_sync(self, tx: bytes):
+        return await self._call("broadcast_tx_sync", {"tx": tx})
+
+    async def broadcast_tx_commit(self, tx: bytes):
+        return await self._call("broadcast_tx_commit", {"tx": tx})
+
+    # abci
+    async def abci_query(self, path: str = "", data: bytes = b"", height: int = 0, prove: bool = False):
+        return await self._call(
+            "abci_query", {"path": path, "data": data, "height": height, "prove": prove}
+        )
+
+    async def abci_info(self):
+        return await self._call("abci_info")
+
+    # tx index
+    async def tx(self, hash: bytes, prove: bool = False):  # noqa: A002
+        return await self._call("tx", {"hash": hash, "prove": prove})
+
+    async def tx_search(self, query: str, prove: bool = False, page: int = 1, per_page: int = 30):
+        return await self._call(
+            "tx_search", {"query": query, "prove": prove, "page": page, "per_page": per_page}
+        )
+
+    async def broadcast_evidence(self, evidence):
+        return await self._call("broadcast_evidence", {"evidence": evidence})
+
+
+class HTTPClient(BaseClient):
+    """JSON-RPC over HTTP POST (rpc/client/http)."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        # accept "host:port", "tcp://host:port" or full http URL
+        if addr.startswith("http://") or addr.startswith("https://"):
+            self.url = addr
+        else:
+            self.url = "http://" + addr.split("://", 1)[-1]
+        self.timeout = aiohttp.ClientTimeout(total=timeout)
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._req_id = 0
+
+    async def _ensure_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(timeout=self.timeout)
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def __aenter__(self) -> "HTTPClient":
+        await self._ensure_session()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def _call(self, method: str, params: Optional[dict] = None) -> Any:
+        self._req_id += 1
+        session = await self._ensure_session()
+        async with session.post(self.url, json=make_request(method, params, self._req_id)) as resp:
+            return parse_response(await resp.text())
+
+
+class WSClient(BaseClient):
+    """JSON-RPC over one WebSocket connection with subscription streaming
+    (rpc/lib/client/ws_client.go).  Responses correlate by request id;
+    ``id:"N#event"`` notifications route to the matching subscription's
+    async iterator."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        base = addr.split("://", 1)[-1].rstrip("/")
+        self.url = f"ws://{base}/websocket"
+        self.timeout = timeout
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._ws: Optional[aiohttp.ClientWebSocketResponse] = None
+        self._recv_task: Optional[asyncio.Task] = None
+        self._req_id = 0
+        self._waiting: Dict[Any, asyncio.Future] = {}
+        self._event_queues: Dict[str, asyncio.Queue] = {}
+
+    async def connect(self) -> "WSClient":
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=self.timeout)
+        )
+        self._ws = await self._session.ws_connect(self.url)
+        self._recv_task = asyncio.create_task(self._recv_loop())
+        return self
+
+    async def close(self) -> None:
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            try:
+                await self._recv_task
+            except asyncio.CancelledError:
+                pass
+        if self._ws is not None:
+            await self._ws.close()
+        if self._session is not None:
+            await self._session.close()
+        for fut in self._waiting.values():
+            if not fut.done():
+                fut.cancel()
+        self._waiting.clear()
+
+    async def __aenter__(self) -> "WSClient":
+        return await self.connect()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def _recv_loop(self) -> None:
+        async for msg in self._ws:
+            if msg.type != aiohttp.WSMsgType.TEXT:
+                break
+            d = json.loads(msg.data)
+            rid = d.get("id")
+            if isinstance(rid, str) and rid.endswith("#event"):
+                result = from_jsonable(d.get("result") or {})
+                q = self._event_queues.get(result.get("query", ""))
+                if q is not None:
+                    q.put_nowait(result)
+                continue
+            fut = self._waiting.pop(rid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(d)
+
+    async def _call(self, method: str, params: Optional[dict] = None) -> Any:
+        self._req_id += 1
+        rid = self._req_id
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._waiting[rid] = fut
+        await self._ws.send_str(json.dumps(make_request(method, params, rid)))
+        d = await asyncio.wait_for(fut, self.timeout)
+        return parse_response(d)
+
+    async def subscribe(self, query: str) -> AsyncIterator[dict]:
+        """Subscribe and return an async iterator of event payloads
+        ({"query", "data": {"type", "value"}, "events"})."""
+        if query in self._event_queues:
+            raise RPCError(-32603, f"already subscribed to {query!r}")
+        q: asyncio.Queue = asyncio.Queue()
+        self._event_queues[query] = q
+        await self._call("subscribe", {"query": query})
+
+        async def gen():
+            while True:
+                yield await q.get()
+
+        return gen()
+
+    async def unsubscribe(self, query: str) -> None:
+        await self._call("unsubscribe", {"query": query})
+        self._event_queues.pop(query, None)
+
+    async def unsubscribe_all(self) -> None:
+        await self._call("unsubscribe_all")
+        self._event_queues.clear()
+
+
+class LocalClient(BaseClient):
+    """In-proc client wrapping a Node directly (rpc/client/local) — no
+    serialization, used by tests and as a lite2 provider substrate."""
+
+    def __init__(self, node):
+        self.node = node
+        self.core = RPCCore(
+            node,
+            unsafe=True,
+            timeout_broadcast_tx_commit=node.config.rpc.timeout_broadcast_tx_commit,
+        )
+        self._sub_seq = 0
+
+    async def _call(self, method: str, params: Optional[dict] = None) -> Any:
+        return await self.core.call(method, params)
+
+    async def subscribe(self, query: str) -> AsyncIterator[dict]:
+        self._sub_seq += 1
+        sub = await self.node.event_bus.subscribe(f"local-{self._sub_seq}", query)
+
+        async def gen():
+            async for msg in sub:
+                yield {
+                    "query": query,
+                    "data": {"type": msg.data.type, "value": msg.data.data},
+                    "events": msg.events,
+                }
+
+        return gen()
+
+    async def close(self) -> None:
+        pass

@@ -1,0 +1,473 @@
+"""RPC core handlers: the route table reading node internals.
+
+Reference parity: rpc/core/routes.go:10-56 (route table),
+rpc/core/status.go, blocks.go, mempool.go (BroadcastTxCommit:56),
+abci.go, consensus.go, net.go, tx.go, events.go (subscribe),
+evidence.go.  Handlers are async methods on RPCCore; the server (HTTP/WS)
+and the in-proc LocalClient both dispatch through `call()`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, Optional
+
+from ..abci.types import RequestInfo, RequestQuery
+from ..libs.log import get_logger
+from ..types.events import EVENT_TX, EVENT_TYPE_KEY, TX_HASH_KEY
+from ..types.tx import tx_hash
+from .jsonrpc import INTERNAL_ERROR, INVALID_PARAMS, METHOD_NOT_FOUND, RPCError
+
+_MAX_PER_PAGE = 100
+
+
+def _paginate(total: int, page: int, per_page: int) -> tuple[int, int]:
+    """rpc/core/env.go validatePage/validatePerPage."""
+    per_page = max(1, min(per_page, _MAX_PER_PAGE))
+    pages = max(1, (total + per_page - 1) // per_page)
+    if page < 1 or page > pages:
+        raise RPCError(INVALID_PARAMS, f"page should be within [1, {pages}] range, given {page}")
+    skip = (page - 1) * per_page
+    return skip, min(skip + per_page, total)
+
+
+class RPCCore:
+    """Handlers bound to one node.  Every public route is a method listed in
+    ROUTES; `call(name, params)` is the single dispatch point."""
+
+    # route name -> method name (identity here, but kept explicit so the
+    # surface mirrors rpc/core/routes.go and typos fail loudly)
+    ROUTES = (
+        "health",
+        "status",
+        "net_info",
+        "genesis",
+        "blockchain",
+        "block",
+        "block_by_hash",
+        "block_results",
+        "commit",
+        "validators",
+        "consensus_params",
+        "consensus_state",
+        "dump_consensus_state",
+        "unconfirmed_txs",
+        "num_unconfirmed_txs",
+        "broadcast_tx_async",
+        "broadcast_tx_sync",
+        "broadcast_tx_commit",
+        "abci_query",
+        "abci_info",
+        "tx",
+        "tx_search",
+        "broadcast_evidence",
+        # unsafe (gated by cfg.rpc.unsafe; routes.go:48-56)
+        "dial_peers",
+        "unsafe_flush_mempool",
+    )
+    UNSAFE = {"dial_peers", "unsafe_flush_mempool"}
+
+    def __init__(self, node, unsafe: bool = False, timeout_broadcast_tx_commit: float = 10.0):
+        self.node = node
+        self.unsafe = unsafe
+        self.timeout_broadcast_tx_commit = timeout_broadcast_tx_commit
+        self.log = get_logger("rpc")
+        self._sub_seq = 0
+
+    async def call(self, method: str, params: Optional[Dict[str, Any]] = None) -> Any:
+        if method not in self.ROUTES:
+            raise RPCError(METHOD_NOT_FOUND, f"unknown method {method!r}")
+        if method in self.UNSAFE and not self.unsafe:
+            raise RPCError(METHOD_NOT_FOUND, f"{method} requires rpc.unsafe=true")
+        handler = getattr(self, method)
+        try:
+            return await handler(**(params or {}))
+        except RPCError:
+            raise
+        except TypeError as e:
+            raise RPCError(INVALID_PARAMS, str(e))
+        except Exception as e:  # noqa: BLE001 — the API boundary
+            self.log.error("rpc handler error", method=method, err=repr(e))
+            raise RPCError(INTERNAL_ERROR, repr(e))
+
+    # -- info routes -------------------------------------------------------
+
+    async def health(self) -> dict:
+        return {}
+
+    async def status(self) -> dict:
+        """rpc/core/status.go:32."""
+        node = self.node
+        bs = node.block_store
+        latest_height = bs.height()
+        meta = bs.load_block_meta(latest_height) if latest_height else None
+        sync_info = {
+            "latest_block_hash": meta.block_id.hash if meta else b"",
+            "latest_app_hash": meta.header.app_hash if meta else b"",
+            "latest_block_height": latest_height,
+            "latest_block_time_ns": meta.header.time_ns if meta else 0,
+            "earliest_block_height": bs.base(),
+            "catching_up": bool(
+                getattr(node, "blockchain_reactor", None)
+                and getattr(node.blockchain_reactor, "fast_sync", False)
+            ),
+        }
+        validator_info = {}
+        if node.priv_validator is not None:
+            pub = node.priv_validator.get_pub_key()
+            addr = pub.address()
+            power = 0
+            if node.consensus is not None and node.consensus.rs.validators is not None:
+                _, val = node.consensus.rs.validators.get_by_address(addr)
+                if val is not None:
+                    power = val.voting_power
+            validator_info = {
+                "address": addr,
+                "pub_key": pub.bytes(),
+                "voting_power": power,
+            }
+        return {
+            "node_info": self._node_info(),
+            "sync_info": sync_info,
+            "validator_info": validator_info,
+        }
+
+    def _node_info(self) -> dict:
+        node = self.node
+        if node.node_key is not None and node.switch is not None:
+            return {
+                "id": node.node_key.id,
+                "listen_addr": getattr(node.switch.transport, "listen_addr", ""),
+                "network": node.genesis_doc.chain_id,
+                "moniker": node.config.base.moniker,
+            }
+        return {
+            "id": "",
+            "listen_addr": "",
+            "network": node.genesis_doc.chain_id,
+            "moniker": node.config.base.moniker,
+        }
+
+    async def net_info(self) -> dict:
+        """rpc/core/net.go:12."""
+        sw = self.node.switch
+        peers = []
+        if sw is not None:
+            for peer in list(sw.peers.values()):
+                peers.append(
+                    {
+                        "node_id": peer.id,
+                        "moniker": getattr(peer.node_info, "moniker", ""),
+                        "is_outbound": getattr(peer, "outbound", False),
+                        "remote_addr": getattr(peer, "remote_addr", ""),
+                    }
+                )
+        return {
+            "listening": sw is not None,
+            "listeners": [getattr(sw.transport, "listen_addr", "")] if sw else [],
+            "n_peers": len(peers),
+            "peers": peers,
+        }
+
+    async def genesis(self) -> dict:
+        import json as _json
+
+        return {"genesis": _json.loads(self.node.genesis_doc.to_json())}
+
+    # -- block routes ------------------------------------------------------
+
+    def _height_or_latest(self, height: Optional[int]) -> int:
+        latest = self.node.block_store.height()
+        if height is None or height <= 0:
+            return latest
+        base = self.node.block_store.base()
+        if height > latest:
+            raise RPCError(
+                INVALID_PARAMS, f"height {height} must be less than or equal to {latest}"
+            )
+        if height < base:
+            raise RPCError(INVALID_PARAMS, f"height {height} is below base height {base}")
+        return height
+
+    async def blockchain(self, min_height: int = 0, max_height: int = 0) -> dict:
+        """rpc/core/blocks.go:23 — metas for [min, max], newest first, ≤20."""
+        bs = self.node.block_store
+        latest = bs.height()
+        if max_height <= 0:
+            max_height = latest
+        max_height = min(max_height, latest)
+        if min_height <= 0:
+            min_height = 1
+        min_height = max(min_height, bs.base(), max_height - 19)
+        if min_height > max_height:
+            raise RPCError(
+                INVALID_PARAMS, f"min_height {min_height} > max_height {max_height}"
+            )
+        metas = []
+        for h in range(max_height, min_height - 1, -1):
+            m = bs.load_block_meta(h)
+            if m is not None:
+                metas.append(m)  # registered type: stays typed through the codec
+        return {"last_height": latest, "block_metas": metas}
+
+    async def block(self, height: Optional[int] = None) -> dict:
+        h = self._height_or_latest(height)
+        meta = self.node.block_store.load_block_meta(h)
+        blk = self.node.block_store.load_block(h)
+        return {
+            "block_id": meta.block_id if meta else None,
+            "block": blk,
+        }
+
+    async def block_by_hash(self, hash: bytes) -> dict:  # noqa: A002 — route name
+        blk = self.node.block_store.load_block_by_hash(hash)
+        if blk is None:
+            return {"block_id": None, "block": None}
+        meta = self.node.block_store.load_block_meta(blk.header.height)
+        return {"block_id": meta.block_id if meta else None, "block": blk}
+
+    async def block_results(self, height: Optional[int] = None) -> dict:
+        h = self._height_or_latest(height)
+        resp = self.node.state_store.load_abci_responses(h)
+        if resp is None:
+            raise RPCError(INVALID_PARAMS, f"no ABCI responses for height {h}")
+        return {"height": h, "results": resp}
+
+    async def commit(self, height: Optional[int] = None) -> dict:
+        """rpc/core/blocks.go:126 — header + commit; canonical iff height
+        below the store tip (the tip's commit is the mutable seen-commit)."""
+        bs = self.node.block_store
+        h = self._height_or_latest(height)
+        meta = bs.load_block_meta(h)
+        if meta is None:
+            raise RPCError(INVALID_PARAMS, f"no block meta at height {h}")
+        if h == bs.height():
+            commit = bs.load_seen_commit(h)
+            canonical = False
+        else:
+            commit = bs.load_block_commit(h)
+            canonical = True
+        from ..types import SignedHeader
+
+        return {
+            "signed_header": SignedHeader(meta.header, commit),
+            "canonical": canonical,
+        }
+
+    async def validators(
+        self, height: Optional[int] = None, page: int = 1, per_page: int = 30
+    ) -> dict:
+        h = self._height_or_latest(height)
+        vals = self.node.state_store.load_validators(h)
+        if vals is None:
+            raise RPCError(INVALID_PARAMS, f"no validator set at height {h}")
+        lo, hi = _paginate(vals.size(), page, per_page)
+        return {
+            "block_height": h,
+            "validators": [v.to_dict() for v in vals.validators[lo:hi]],
+            "count": hi - lo,
+            "total": vals.size(),
+        }
+
+    async def consensus_params(self, height: Optional[int] = None) -> dict:
+        h = self._height_or_latest(height)
+        params = self.node.state_store.load_consensus_params(h)
+        return {"block_height": h, "consensus_params": params.to_dict() if params else None}
+
+    # -- consensus introspection ------------------------------------------
+
+    def _round_state_dict(self, full: bool) -> dict:
+        cs = self.node.consensus
+        if cs is None:
+            return {}
+        rs = cs.rs
+        d = {
+            "height": rs.height,
+            "round": rs.round,
+            "step": rs.step,
+            "start_time": rs.start_time,
+            "commit_time": rs.commit_time,
+            "locked_round": rs.locked_round,
+            "valid_round": rs.valid_round,
+            "triggered_timeout_precommit": rs.triggered_timeout_precommit,
+        }
+        if rs.proposal is not None:
+            d["proposal"] = rs.proposal.to_dict()
+        if rs.locked_block is not None:
+            d["locked_block_hash"] = rs.locked_block.hash()
+        if rs.valid_block is not None:
+            d["valid_block_hash"] = rs.valid_block.hash()
+        if rs.votes is not None:
+            rounds = {}
+            for r in range(rs.round + 1):
+                pv, pc = rs.votes.prevotes(r), rs.votes.precommits(r)
+                rounds[r] = {
+                    "prevotes": str(pv) if pv else None,
+                    "precommits": str(pc) if pc else None,
+                }
+            d["height_vote_set"] = rounds
+        if full and rs.validators is not None:
+            d["validators"] = rs.validators
+        return d
+
+    async def consensus_state(self) -> dict:
+        """rpc/core/consensus.go:68 — the compact round-state summary."""
+        return {"round_state": self._round_state_dict(full=False)}
+
+    async def dump_consensus_state(self) -> dict:
+        """rpc/core/consensus.go:36 — full round state + peer round states."""
+        peers = []
+        reactor = self.node.consensus_reactor
+        if reactor is not None:
+            for peer_id, ps in getattr(reactor, "peer_states", {}).items():
+                peers.append(
+                    {
+                        "node_address": peer_id,
+                        "peer_round_state": {
+                            "height": ps.height,
+                            "round": ps.round,
+                            "step": getattr(ps, "step", 0),
+                        },
+                    }
+                )
+        return {"round_state": self._round_state_dict(full=True), "peers": peers}
+
+    # -- mempool routes ----------------------------------------------------
+
+    async def unconfirmed_txs(self, limit: int = 30) -> dict:
+        limit = max(1, min(limit, _MAX_PER_PAGE))
+        txs = self.node.mempool.reap_max_txs(limit)
+        return {
+            "n_txs": len(txs),
+            "total": self.node.mempool.size(),
+            "txs": txs,
+        }
+
+    async def num_unconfirmed_txs(self) -> dict:
+        return {"n_txs": self.node.mempool.size(), "total": self.node.mempool.size()}
+
+    async def broadcast_tx_async(self, tx: bytes) -> dict:
+        """rpc/core/mempool.go:22 — fire and forget."""
+        asyncio.ensure_future(self.node.mempool.check_tx(tx))
+        return {"code": 0, "data": b"", "log": "", "hash": tx_hash(tx)}
+
+    async def broadcast_tx_sync(self, tx: bytes) -> dict:
+        """rpc/core/mempool.go:36 — wait for CheckTx."""
+        res = await self.node.mempool.check_tx(tx)
+        return {
+            "code": res.code,
+            "data": res.data,
+            "log": res.log,
+            "hash": tx_hash(tx),
+        }
+
+    async def broadcast_tx_commit(self, tx: bytes) -> dict:
+        """rpc/core/mempool.go:56 — CheckTx, then wait for the DeliverTx
+        event via an EventBus subscription (the reference flow verbatim:
+        subscribe first so the commit can't race the wait)."""
+        bus = self.node.event_bus
+        h = tx_hash(tx)
+        self._sub_seq += 1
+        subscriber = f"broadcast_tx_commit-{self._sub_seq}"
+        q = f"{EVENT_TYPE_KEY}='{EVENT_TX}' AND {TX_HASH_KEY}='{h.hex().upper()}'"
+        sub = await bus.subscribe(subscriber, q)
+        try:
+            check = await self.node.mempool.check_tx(tx)
+            if check.code != 0:
+                return {
+                    "check_tx": check,
+                    "deliver_tx": None,
+                    "hash": h,
+                    "height": 0,
+                }
+            try:
+                msg = await asyncio.wait_for(sub.next(), self.timeout_broadcast_tx_commit)
+            except asyncio.TimeoutError:
+                raise RPCError(INTERNAL_ERROR, "timed out waiting for tx to be included in a block")
+            data = msg.data.data  # Message.data is the Event; Event.data the payload
+            return {
+                "check_tx": check,
+                "deliver_tx": data["result"],
+                "hash": h,
+                "height": data["height"],
+            }
+        finally:
+            await bus.unsubscribe_all(subscriber)
+
+    # -- abci routes -------------------------------------------------------
+
+    async def abci_query(
+        self, path: str = "", data: bytes = b"", height: int = 0, prove: bool = False
+    ) -> dict:
+        res = await self.node.proxy_app.query().query(
+            RequestQuery(data=data, path=path, height=height, prove=prove)
+        )
+        return {"response": res}
+
+    async def abci_info(self) -> dict:
+        res = await self.node.proxy_app.query().info(RequestInfo(version="rpc"))
+        return {"response": res}
+
+    # -- tx index routes ---------------------------------------------------
+
+    async def tx(self, hash: bytes, prove: bool = False) -> dict:  # noqa: A002
+        res = self.node.tx_indexer.get(hash)
+        if res is None:
+            raise RPCError(INVALID_PARAMS, f"tx ({hash.hex()}) not found")
+        out = dict(res)
+        out["hash"] = hash
+        if prove:
+            proof = self._tx_proof(res["height"], res["index"])
+            if proof is not None:
+                out["proof"] = proof
+        return out
+
+    def _tx_proof(self, height: int, index: int):
+        """Merkle proof of tx inclusion under the block's data_hash
+        (types/tx.go Txs.Proof)."""
+        from ..crypto.merkle import proofs_from_byte_slices
+        from ..types.tx import tx_hash as _th
+
+        blk = self.node.block_store.load_block(height)
+        if blk is None or index >= len(blk.txs):
+            return None
+        root, proofs = proofs_from_byte_slices([_th(t) for t in blk.txs])
+        return {"root_hash": root, "proof": proofs[index].to_dict()}
+
+    async def tx_search(
+        self, query: str, prove: bool = False, page: int = 1, per_page: int = 30
+    ) -> dict:
+        results = self.node.tx_indexer.search(query, limit=10_000)
+        lo, hi = _paginate(len(results), page, per_page)
+        txs = []
+        for res in results[lo:hi]:
+            out = dict(res)
+            if prove and "height" in res and "index" in res:
+                proof = self._tx_proof(res["height"], res["index"])
+                if proof is not None:
+                    out["proof"] = proof
+            txs.append(out)
+        return {"txs": txs, "total_count": len(results)}
+
+    # -- evidence ----------------------------------------------------------
+
+    async def broadcast_evidence(self, evidence) -> dict:
+        self.node.evidence_pool.add_evidence(evidence)
+        return {"hash": evidence.hash()}
+
+    # -- unsafe ------------------------------------------------------------
+
+    async def dial_peers(self, peers: list, persistent: bool = False) -> dict:
+        if self.node.switch is None:
+            raise RPCError(INTERNAL_ERROR, "p2p is disabled")
+        await self.node.switch.dial_peers_async(list(peers), persistent=persistent)
+        return {"log": f"dialing {len(peers)} peers"}
+
+    async def unsafe_flush_mempool(self) -> dict:
+        await self.node.mempool.flush()
+        return {}
+
+
+def now_ns() -> int:
+    return time.time_ns()

@@ -1,0 +1,111 @@
+"""JSON-RPC 2.0 envelope + JSON-safe value codec.
+
+Reference parity: rpc/lib/types/types.go (RPCRequest/RPCResponse/RPCError)
+and the amino-JSON value encoding.  Wire JSON here is our own shape: domain
+objects ride as ``{"@t": tag, ...to_dict()}`` using the same registry as
+the msgpack transport codec (encoding/codec.py), and bytes ride as
+``{"@b": base64}`` — lossless round-trip without a second registry.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Optional
+
+from ..encoding import codec
+
+# JSON-RPC 2.0 error codes (rpc/lib/types/types.go:153ff)
+PARSE_ERROR = -32700
+INVALID_REQUEST = -32600
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+INTERNAL_ERROR = -32603
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+    def to_dict(self) -> dict:
+        d = {"code": self.code, "message": self.message}
+        if self.data:
+            d["data"] = self.data
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RPCError":
+        return cls(d.get("code", INTERNAL_ERROR), d.get("message", ""), d.get("data", ""))
+
+
+def to_jsonable(x: Any) -> Any:
+    """Recursively convert a value (possibly containing registered domain
+    objects and bytes) into JSON-serializable structure."""
+    tag = codec.tag_for(type(x))
+    if tag is not None:
+        d = {k: to_jsonable(v) for k, v in x.to_dict().items()}
+        d["@t"] = tag
+        return d
+    if isinstance(x, dict):
+        return {str(k): to_jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [to_jsonable(v) for v in x]
+    if isinstance(x, (bytes, bytearray)):
+        return {"@b": base64.b64encode(bytes(x)).decode()}
+    if x is None or isinstance(x, (str, int, float, bool)):
+        return x
+    if hasattr(x, "to_dict"):
+        return {k: to_jsonable(v) for k, v in x.to_dict().items()}
+    if hasattr(x, "__dict__"):  # dataclasses without to_dict (ABCI responses)
+        return {k: to_jsonable(v) for k, v in vars(x).items()}
+    return repr(x)
+
+
+def from_jsonable(x: Any) -> Any:
+    """Inverse of to_jsonable: bytes markers decode, tagged dicts rebuild
+    their registered class; plain dicts/lists recurse."""
+    if isinstance(x, dict):
+        if set(x.keys()) == {"@b"}:
+            return base64.b64decode(x["@b"])
+        tag = x.get("@t")
+        d = {k: from_jsonable(v) for k, v in x.items() if k != "@t"}
+        if tag is not None:
+            cls = codec.class_for(tag)
+            if cls is not None:
+                # from_dict implementations expect raw to_dict shape: nested
+                # bytes decoded, nested plain dicts untouched — which is
+                # exactly what the recursion above produced.
+                return cls.from_dict(d)
+        return d
+    if isinstance(x, list):
+        return [from_jsonable(v) for v in x]
+    return x
+
+
+def make_request(method: str, params: Optional[dict] = None, req_id: Any = 0) -> dict:
+    return {
+        "jsonrpc": "2.0",
+        "id": req_id,
+        "method": method,
+        "params": to_jsonable(params or {}),
+    }
+
+
+def make_response(req_id: Any, result: Any = None, error: Optional[RPCError] = None) -> dict:
+    resp: dict = {"jsonrpc": "2.0", "id": req_id}
+    if error is not None:
+        resp["error"] = error.to_dict()
+    else:
+        resp["result"] = to_jsonable(result)
+    return resp
+
+
+def parse_response(raw: str | bytes | dict) -> Any:
+    """Decode a response; raises RPCError on error responses."""
+    d = json.loads(raw) if not isinstance(raw, dict) else raw
+    if d.get("error"):
+        raise RPCError.from_dict(d["error"])
+    return from_jsonable(d.get("result"))

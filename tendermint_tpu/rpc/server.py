@@ -1,0 +1,251 @@
+"""HTTP + WebSocket JSON-RPC server over aiohttp.
+
+Reference parity: rpc/lib/server/http_server.go (listener, body limits),
+http_json_handler.go (POST JSON-RPC incl. batches), http_uri_handler.go
+(GET with URI params), ws_handler.go (WebSocket endpoint with per-client
+subscription management — subscribe/unsubscribe/unsubscribe_all run only
+in WS context, events stream as JSON-RPC notifications).
+
+aiohttp plays the role Go's net/http does in the reference: the socket
+substrate under our own routing/envelope layer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Optional
+
+from aiohttp import WSMsgType, web
+
+from ..libs.log import get_logger
+from ..libs.service import Service
+from .core import RPCCore
+from .jsonrpc import (
+    INTERNAL_ERROR,
+    INVALID_PARAMS,
+    INVALID_REQUEST,
+    METHOD_NOT_FOUND,
+    PARSE_ERROR,
+    RPCError,
+    from_jsonable,
+    make_response,
+)
+
+
+def _parse_laddr(laddr: str) -> tuple[str, int]:
+    """tcp://host:port (or host:port) -> (host, port)."""
+    addr = laddr.split("://", 1)[-1]
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def _coerce_uri_param(v: str) -> Any:
+    """GET query params arrive as strings; mirror the reference's loose URI
+    coercion (http_uri_handler.go): quoted strings, 0x-hex bytes, ints,
+    bools, else raw string."""
+    if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+        return v[1:-1]
+    if v.startswith("0x"):
+        try:
+            return bytes.fromhex(v[2:])
+        except ValueError:
+            return v
+    if v in ("true", "false"):
+        return v == "true"
+    try:
+        return int(v)
+    except ValueError:
+        return v
+
+
+class RPCServer(Service):
+    """One per node; serves cfg.rpc.laddr."""
+
+    def __init__(self, node, rpc_cfg):
+        super().__init__("rpc-server")
+        self.node = node
+        self.cfg = rpc_cfg
+        self.core = RPCCore(
+            node,
+            unsafe=rpc_cfg.unsafe,
+            timeout_broadcast_tx_commit=rpc_cfg.timeout_broadcast_tx_commit,
+        )
+        self.log = get_logger("rpc.server")
+        self._runner: Optional[web.AppRunner] = None
+        self._site = None
+        self.listen_addr: str = ""
+        self._ws_clients: set = set()
+        self._ws_seq = 0
+
+    async def on_start(self) -> None:
+        app = web.Application(client_max_size=self.cfg.max_body_bytes)
+        app.router.add_post("/", self._handle_post)
+        app.router.add_get("/websocket", self._handle_ws)
+        app.router.add_get("/{method}", self._handle_get)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        host, port = _parse_laddr(self.cfg.laddr)
+        self._site = web.TCPSite(self._runner, host, port)
+        await self._site.start()
+        # resolve ephemeral port for tests (laddr ...:0)
+        server = self._site._server  # noqa: SLF001 — aiohttp has no getter
+        if server and server.sockets:
+            sock = server.sockets[0]
+            self.listen_addr = "%s:%d" % sock.getsockname()[:2]
+        else:
+            self.listen_addr = f"{host}:{port}"
+
+    async def on_stop(self) -> None:
+        for ws in list(self._ws_clients):
+            await ws.close()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- HTTP POST: JSON-RPC (single or batch) ----------------------------
+
+    async def _handle_post(self, request: web.Request) -> web.Response:
+        try:
+            payload = json.loads(await request.read())
+        except (ValueError, UnicodeDecodeError):
+            return web.json_response(
+                make_response(None, error=RPCError(PARSE_ERROR, "invalid JSON"))
+            )
+        if isinstance(payload, list):  # batch (http_json_handler.go:66)
+            out = await asyncio.gather(*(self._dispatch(r) for r in payload))
+            return web.json_response(out)
+        return web.json_response(await self._dispatch(payload))
+
+    async def _dispatch(self, req: Any) -> dict:
+        if not isinstance(req, dict) or "method" not in req:
+            return make_response(None, error=RPCError(INVALID_REQUEST, "malformed request"))
+        req_id = req.get("id")
+        method = req["method"]
+        params = from_jsonable(req.get("params") or {})
+        if not isinstance(params, dict):
+            return make_response(
+                req_id, error=RPCError(INVALID_PARAMS, "params must be an object")
+            )
+        if method in ("subscribe", "unsubscribe", "unsubscribe_all"):
+            return make_response(
+                req_id,
+                error=RPCError(
+                    METHOD_NOT_FOUND, f"{method} is only available over /websocket"
+                ),
+            )
+        try:
+            result = await self.core.call(method, params)
+            return make_response(req_id, result)
+        except RPCError as e:
+            return make_response(req_id, error=e)
+
+    # -- HTTP GET: URI params ---------------------------------------------
+
+    async def _handle_get(self, request: web.Request) -> web.Response:
+        method = request.match_info["method"]
+        params = {k: _coerce_uri_param(v) for k, v in request.query.items()}
+        if method in ("subscribe", "unsubscribe", "unsubscribe_all"):
+            return web.json_response(
+                make_response(-1, error=RPCError(METHOD_NOT_FOUND, "use /websocket"))
+            )
+        try:
+            result = await self.core.call(method, params)
+            return web.json_response(make_response(-1, result))
+        except RPCError as e:
+            return web.json_response(make_response(-1, error=e))
+
+    # -- WebSocket: full surface + subscriptions --------------------------
+
+    async def _handle_ws(self, request: web.Request) -> web.WebSocketResponse:
+        if (
+            self.cfg.max_subscription_clients > 0
+            and len(self._ws_clients) >= self.cfg.max_subscription_clients
+        ):
+            raise web.HTTPServiceUnavailable(text="max subscription clients reached")
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        self._ws_clients.add(ws)
+        self._ws_seq += 1
+        subscriber = f"ws-{self._ws_seq}"
+        # query string -> pump task streaming matching events to this client
+        subs: dict[str, asyncio.Task] = {}
+        try:
+            async for msg in ws:
+                if msg.type != WSMsgType.TEXT:
+                    continue
+                try:
+                    req = json.loads(msg.data)
+                except ValueError:
+                    await ws.send_json(
+                        make_response(None, error=RPCError(PARSE_ERROR, "invalid JSON"))
+                    )
+                    continue
+                await self._ws_dispatch(ws, subscriber, subs, req)
+        finally:
+            for task in subs.values():
+                task.cancel()
+            await self.node.event_bus.unsubscribe_all(subscriber)
+            self._ws_clients.discard(ws)
+        return ws
+
+    async def _ws_dispatch(self, ws, subscriber: str, subs: dict, req: Any) -> None:
+        if not isinstance(req, dict) or "method" not in req:
+            await ws.send_json(
+                make_response(None, error=RPCError(INVALID_REQUEST, "malformed request"))
+            )
+            return
+        req_id = req.get("id")
+        method = req["method"]
+        params = from_jsonable(req.get("params") or {})
+        try:
+            if method == "subscribe":
+                query = params.get("query", "")
+                if not query:
+                    raise RPCError(INVALID_PARAMS, "missing query")
+                if len(subs) >= self.cfg.max_subscriptions_per_client > 0:
+                    raise RPCError(INTERNAL_ERROR, "max subscriptions per client reached")
+                if query in subs:
+                    raise RPCError(INTERNAL_ERROR, f"already subscribed to {query!r}")
+                sub = await self.node.event_bus.subscribe(subscriber, query)
+                subs[query] = asyncio.create_task(self._pump(ws, req_id, query, sub))
+                await ws.send_json(make_response(req_id, {}))
+            elif method == "unsubscribe":
+                query = params.get("query", "")
+                task = subs.pop(query, None)
+                if task is None:
+                    raise RPCError(INVALID_PARAMS, f"not subscribed to {query!r}")
+                task.cancel()
+                await self.node.event_bus.unsubscribe(subscriber, query)
+                await ws.send_json(make_response(req_id, {}))
+            elif method == "unsubscribe_all":
+                for task in subs.values():
+                    task.cancel()
+                subs.clear()
+                await self.node.event_bus.unsubscribe_all(subscriber)
+                await ws.send_json(make_response(req_id, {}))
+            else:
+                result = await self.core.call(method, params if isinstance(params, dict) else {})
+                await ws.send_json(make_response(req_id, result))
+        except RPCError as e:
+            try:
+                await ws.send_json(make_response(req_id, error=e))
+            except ConnectionError:
+                pass
+
+    async def _pump(self, ws, req_id, query: str, sub) -> None:
+        """Stream matching events to the client as JSON-RPC notifications
+        (ws_handler.go: id = original id + '#event')."""
+        try:
+            async for msg in sub:
+                await ws.send_json(
+                    make_response(
+                        f"{req_id}#event",
+                        {
+                            "query": query,
+                            "data": {"type": msg.data.type, "value": msg.data.data},
+                            "events": msg.events,
+                        },
+                    )
+                )
+        except (ConnectionError, asyncio.CancelledError):
+            pass

@@ -46,6 +46,43 @@ def safe_sub_clip(a: int, b: int) -> int:
     return min(max(c, INT64_MIN), INT64_MAX)
 
 
+def mixed_batch_verify(
+    pubkey_objs: Sequence[PubKey],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    batch_verify: Optional[Callable] = None,
+) -> List[bool]:
+    """Verify a commit's signatures, routing by key type: ed25519 rides the
+    installed device batch (crypto/batch.py); other key types (sr25519,
+    secp256k1, threshold multisig) verify via their own PubKey.verify — the
+    reference's per-key-type dispatch (crypto.PubKey interface), batched
+    where the hardware pays off."""
+    from ..crypto.keys import Ed25519PubKey
+
+    n = len(msgs)
+    out: List[bool] = [False] * n
+    ed_idx = [i for i, pk in enumerate(pubkey_objs) if isinstance(pk, Ed25519PubKey)]
+    if ed_idx:
+        verify = batch_verify or crypto_batch.get_verifier()
+        res = verify(
+            [pubkey_objs[i].bytes() for i in ed_idx],
+            [msgs[i] for i in ed_idx],
+            [sigs[i] for i in ed_idx],
+        )
+        for i, r in zip(ed_idx, res):
+            out[i] = bool(r)
+    if len(ed_idx) != n:
+        ed_set = set(ed_idx)
+        for i, pk in enumerate(pubkey_objs):
+            if i in ed_set:
+                continue
+            try:
+                out[i] = bool(pk.verify(msgs[i], sigs[i]))
+            except Exception:
+                out[i] = False
+    return out
+
+
 class NotEnoughVotingPowerError(Exception):
     """types/validator_set.go:838 ErrNotEnoughVotingPowerSigned."""
 
@@ -399,12 +436,11 @@ class ValidatorSet:
             if cs.is_absent():
                 continue
             idxs.append(idx)
-            pubkeys.append(self.validators[idx].pub_key.bytes())
+            pubkeys.append(self.validators[idx].pub_key)
             msgs.append(commit.vote_sign_bytes(chain_id, idx))
             sigs.append(cs.signature)
 
-        verify = batch_verify or crypto_batch.get_verifier()
-        ok = verify(pubkeys, msgs, sigs)
+        ok = mixed_batch_verify(pubkeys, msgs, sigs, batch_verify)
 
         tallied = 0
         needed = self.total_voting_power() * 2 // 3
@@ -444,12 +480,11 @@ class ValidatorSet:
             seen.add(old_idx)
             idxs.append(idx)
             powers.append(val.voting_power)
-            pubkeys.append(val.pub_key.bytes())
+            pubkeys.append(val.pub_key)
             msgs.append(commit.vote_sign_bytes(chain_id, idx))
             sigs.append(cs.signature)
 
-        verify = batch_verify or crypto_batch.get_verifier()
-        ok = verify(pubkeys, msgs, sigs)
+        ok = mixed_batch_verify(pubkeys, msgs, sigs, batch_verify)
         for pos, idx in enumerate(idxs):
             if not ok[pos]:
                 raise ValueError(f"wrong signature (#{idx}): {sigs[pos].hex()}")
@@ -494,12 +529,11 @@ class ValidatorSet:
             seen_vals[val_idx] = idx
             idxs.append(idx)
             powers.append(val.voting_power)
-            pubkeys.append(val.pub_key.bytes())
+            pubkeys.append(val.pub_key)
             msgs.append(commit.vote_sign_bytes(chain_id, idx))
             sigs.append(cs.signature)
 
-        verify = batch_verify or crypto_batch.get_verifier()
-        ok = verify(pubkeys, msgs, sigs)
+        ok = mixed_batch_verify(pubkeys, msgs, sigs, batch_verify)
 
         tallied = 0
         needed = self.total_voting_power() * trust_numerator // trust_denominator

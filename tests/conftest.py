@@ -7,7 +7,10 @@ and the driver's dryrun).  Environment must be set before jax imports.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force cpu even if the ambient environment points at a (tunnel-attached)
+# accelerator: per-vote flush batches would pay a host<->device round trip
+# per call, and compiles are minutes, not seconds, over the tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

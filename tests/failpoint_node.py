@@ -1,0 +1,57 @@
+"""Subprocess entry for the fail-point kill harness
+(reference: test/persist/test_failure_indices.sh runs the real binary with
+FAIL_TEST_INDEX and asserts recovery).
+
+Runs a solo-validator node from a CLI-initialized home until the block
+store reaches --blocks, then exits 0.  With FAIL_TEST_INDEX set, libs/fail
+os._exits at that call index instead.
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--home", required=True)
+    p.add_argument("--blocks", type=int, default=3)
+    p.add_argument("--timeout", type=float, default=60.0)
+    args = p.parse_args()
+
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import default_new_node
+
+    cfg = load_config(os.path.join(args.home, "config", "config.toml"), home=args.home)
+    cfg.rpc.laddr = ""
+    cfg.p2p.laddr = ""
+    cfg.tpu.enabled = False
+    cfg.consensus.timeout_commit = 0.02
+    cfg.consensus.skip_timeout_commit = False
+    cfg.consensus.timeout_propose = 2.0
+    node = default_new_node(cfg)
+
+    async def run() -> int:
+        await node.start()
+        target = node.block_store.height() + args.blocks
+
+        async def wait():
+            while node.block_store.height() < target:
+                await asyncio.sleep(0.02)
+
+        try:
+            await asyncio.wait_for(wait(), args.timeout)
+        finally:
+            await node.stop()
+        return 0
+
+    return asyncio.run(run())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+"""CLI + testnet-generator tests (reference: cmd/tendermint/commands).
+
+The localnet test is the VERDICT #9 criterion: a 4-node net launches from
+CLI-generated config trees (no hand-written Python wiring) and commits
+blocks.
+"""
+
+import asyncio
+import json
+import os
+
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import load_config
+
+
+def run_cli(*argv):
+    return cli_main(list(argv))
+
+
+class TestBasicCommands:
+    def test_init_creates_tree(self, tmp_path, capsys):
+        home = str(tmp_path / "home")
+        assert run_cli("--home", home, "init", "--chain-id", "cli-chain") == 0
+        assert os.path.exists(os.path.join(home, "config", "config.toml"))
+        assert os.path.exists(os.path.join(home, "config", "genesis.json"))
+        assert os.path.exists(os.path.join(home, "config", "priv_validator_key.json"))
+        assert os.path.exists(os.path.join(home, "config", "node_key.json"))
+        cfg = load_config(os.path.join(home, "config", "config.toml"), home=home)
+        assert cfg.base.chain_id == "cli-chain"
+
+    def test_gen_validator_json(self, capsys):
+        assert run_cli("gen_validator") == 0
+        d = json.loads(capsys.readouterr().out)
+        assert len(bytes.fromhex(d["priv_key"]["value"])) == 32
+
+    def test_show_node_id_and_validator(self, tmp_path, capsys):
+        home = str(tmp_path / "home")
+        run_cli("--home", home, "init")
+        capsys.readouterr()
+        assert run_cli("--home", home, "show_node_id") == 0
+        node_id = capsys.readouterr().out.strip()
+        assert len(node_id) == 40  # hex address
+        assert run_cli("--home", home, "show_validator") == 0
+        d = json.loads(capsys.readouterr().out)
+        assert len(bytes.fromhex(d["value"])) == 32
+
+    def test_unsafe_reset_all(self, tmp_path, capsys):
+        home = str(tmp_path / "home")
+        run_cli("--home", home, "init")
+        marker = os.path.join(home, "data", "blockstore.db")
+        open(marker, "w").write("x")
+        assert run_cli("--home", home, "unsafe_reset_all") == 0
+        assert not os.path.exists(marker)
+
+    def test_version(self, capsys):
+        assert run_cli("version") == 0
+        assert capsys.readouterr().out.strip()
+
+
+class TestTestnet:
+    def test_generates_wired_configs(self, tmp_path, capsys):
+        out = str(tmp_path / "net")
+        assert run_cli("testnet", "-v", "4", "-o", out, "--chain-id", "tn") == 0
+        genesis_hashes = set()
+        ids = []
+        for i in range(4):
+            home = os.path.join(out, f"node{i}")
+            cfg = load_config(os.path.join(home, "config", "config.toml"), home=home)
+            assert cfg.base.chain_id == "tn"
+            peers = cfg.p2p.persistent_peers.split(",")
+            assert len(peers) == 3  # everyone else
+            from tendermint_tpu.types import GenesisDoc
+
+            gen = GenesisDoc.from_file(cfg.genesis_file())
+            assert len(gen.validators) == 4
+            genesis_hashes.add(gen.validator_hash())
+            from tendermint_tpu.p2p.key import NodeKey
+
+            ids.append(NodeKey.load(cfg.node_key_file()).id)
+        assert len(genesis_hashes) == 1  # identical genesis everywhere
+        assert len(set(ids)) == 4
+
+    async def test_localnet_from_generated_configs(self, tmp_path):
+        """Launch all 4 nodes exactly as `node` would (default_new_node on
+        the generated config tree) and watch them commit together."""
+        from tendermint_tpu.node import default_new_node
+
+        out = str(tmp_path / "net")
+        run_cli("testnet", "-v", "4", "-o", out, "--base-port", "28700")
+        nodes = []
+        try:
+            for i in range(4):
+                home = os.path.join(out, f"node{i}")
+                cfg = load_config(os.path.join(home, "config", "config.toml"), home=home)
+                # operator-style tweaks for CI: memdb speed + quiet engine
+                # (the device path is covered by test_node_wiring)
+                cfg.base.db_backend = "memdb"
+                cfg.tpu.enabled = False
+                cfg.rpc.laddr = ""
+                cfg.base.fast_sync = False
+                cfg.consensus.timeout_commit = 0.1
+                cfg.consensus.timeout_propose = 2.0
+                nodes.append(default_new_node(cfg))
+            await asyncio.gather(*(n.start() for n in nodes))
+
+            async def all_reach(h):
+                while not all(n.block_store.height() >= h for n in nodes):
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(all_reach(2), 60.0)
+            hashes = {n.block_store.load_block(1).hash() for n in nodes}
+            assert len(hashes) == 1
+        finally:
+            for n in nodes:
+                if n.is_running:
+                    await n.stop()

@@ -1,0 +1,224 @@
+"""Crash-recovery rigs (VERDICT #5; reference: consensus/replay_test.go
+crashingWAL + test/persist/test_failure_indices.sh + byzantine_test.go:27).
+
+(a) crashing-WAL: kill consensus at every WAL record index, restart on the
+    same stores, assert resume past the crash height.
+(b) fail-point kills: real subprocess os._exit at each FAIL_TEST_INDEX
+    crash site (finalize-*/applyblock-*), restart, assert recovery.
+(c) byzantine proposer: conflicting proposals to different peers via the
+    overridable decide_proposal; honest majority keeps committing.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import tendermint_tpu.node as node_module
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import test_config as make_test_cfg
+from tendermint_tpu.consensus.wal import WAL
+from tendermint_tpu.node import Node
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class WALCrash(Exception):
+    pass
+
+
+class CrashingWAL(WAL):
+    """replay_test.go crashingWAL: raise on the Nth write, passthrough
+    otherwise.  Class-level countdown so a fresh instance per node start
+    still honors the schedule."""
+
+    crash_after = -1  # set by the test; -1 = disabled
+
+    def __init__(self, path):
+        super().__init__(path)
+
+    def _tick(self):
+        cls = CrashingWAL
+        if cls.crash_after < 0:
+            return
+        if cls.crash_after == 0:
+            cls.crash_after = -1
+            raise WALCrash("simulated WAL crash")
+        cls.crash_after -= 1
+
+    def write(self, payload):
+        self._tick()
+        super().write(payload)
+
+    def write_sync(self, payload):
+        self._tick()
+        super().write_sync(payload)
+
+
+def _solo_cfg(tmp_path, name):
+    cfg = make_test_cfg(str(tmp_path / name))
+    cfg.base.db_backend = "sqlite"
+    cfg.rpc.laddr = ""
+    cfg.consensus.skip_timeout_commit = False
+    cfg.consensus.timeout_commit = 0.02
+    cfg.ensure_dirs()
+    return cfg
+
+
+def _gen(pvs, chain="crash-chain"):
+    return GenesisDoc(
+        chain_id=chain,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
+    )
+
+
+class TestCrashingWAL:
+    async def test_crash_at_every_wal_record_then_recover(self, tmp_path, monkeypatch):
+        """Run a solo validator; for each crash index N, crash the WAL at
+        record N mid-flight, restart on the same home, and require progress
+        beyond the pre-crash height.  One shared home so each iteration
+        also exercises handshake catchup over the previous history."""
+        monkeypatch.setattr(node_module, "WAL", CrashingWAL)
+        pv = MockPV()
+        gen = _gen([pv])
+        home_i = 0
+        for crash_n in range(1, 14, 2):
+            home_i += 1
+            cfg = _solo_cfg(tmp_path, f"wal{home_i}")
+            CrashingWAL.crash_after = crash_n
+            node = Node(cfg, gen, priv_validator=pv)
+            await node.start()
+            # consensus dies at the Nth WAL record (receive loop exits)
+            await asyncio.wait_for(node.consensus.wait_done(), 30.0)
+            crashed_height = node.block_store.height()
+            await node.stop()
+
+            # restart clean on the same stores: WAL catchup + handshake
+            CrashingWAL.crash_after = -1
+            node2 = Node(cfg, gen, priv_validator=pv)
+            await node2.start()
+
+            async def past(h):
+                while node2.block_store.height() < h:
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(past(crashed_height + 2), 30.0)
+            await node2.stop()
+
+
+@pytest.mark.parametrize("indices", [range(0, 5), range(5, 10)])
+class TestFailPointKills:
+    def test_kill_and_recover(self, tmp_path, indices):
+        """test_failure_indices.sh: run the node subprocess with
+        FAIL_TEST_INDEX=i (hard os._exit at crash site i), then restart
+        without it and require 2 more committed blocks."""
+        home = str(tmp_path / "fp-home")
+        assert cli_main(["--home", home, "init", "--chain-id", "fp-chain"]) == 0
+        runner = os.path.join(REPO, "tests", "failpoint_node.py")
+        base_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        base_env.pop("FAIL_TEST_INDEX", None)
+
+        for i in indices:
+            crash = subprocess.run(
+                [sys.executable, runner, "--home", home, "--blocks", "3"],
+                env={**base_env, "FAIL_TEST_INDEX": str(i)},
+                capture_output=True,
+                timeout=90,
+                text=True,
+            )
+            assert crash.returncode == 1, (
+                f"index {i}: expected fail-point exit, got rc={crash.returncode}\n"
+                f"{crash.stdout}\n{crash.stderr}"
+            )
+            recover = subprocess.run(
+                [sys.executable, runner, "--home", home, "--blocks", "2"],
+                env=base_env,
+                capture_output=True,
+                timeout=90,
+                text=True,
+            )
+            assert recover.returncode == 0, (
+                f"index {i}: recovery failed rc={recover.returncode}\n"
+                f"{recover.stdout}\n{recover.stderr}"
+            )
+
+
+class TestByzantineProposer:
+    async def test_conflicting_proposals_do_not_halt_net(self, tmp_path):
+        """byzantine_test.go:27 — node0 equivocates: proposal A (+parts) to
+        one peer, proposal B to the others.  With 3 of 4 honest the network
+        must keep committing and stay consistent."""
+        from tests.test_consensus_net import make_net, stop_net, wait_all_height
+
+        nodes, pvs = await make_net(tmp_path, 4, name="byzprop")
+        byz = nodes[0]
+        cs = byz.consensus
+        reactor = byz.consensus_reactor
+
+        from tendermint_tpu.consensus.reactor import DATA_CHANNEL, _enc
+        from tendermint_tpu.types import BlockID
+        from tendermint_tpu.types.part_set import BLOCK_PART_SIZE_BYTES
+        from tendermint_tpu.types.proposal import Proposal
+
+        async def byz_decide_proposal(height, round_):
+            created = cs._create_proposal_block()
+            if created is None:
+                return
+            block_a, parts_a = created
+            # a second, conflicting block with different data
+            commit = (
+                cs.rs.last_commit.make_commit()
+                if height > 1 and cs.rs.last_commit is not None
+                else __import__(
+                    "tendermint_tpu.types.block", fromlist=["Commit"]
+                ).Commit(0, 0, BlockID(), [])
+            )
+            block_b = cs.sm_state.make_block(
+                height, [b"byz-conflicting-tx"], commit, [], pvs[0].address()
+            )
+            parts_b = block_b.make_part_set(BLOCK_PART_SIZE_BYTES)
+
+            peers = list(byz.switch.peers.values())
+            half = max(1, len(peers) // 2)
+            for grp, (blk, parts) in (
+                (peers[:half], (block_a, parts_a)),
+                (peers[half:], (block_b, parts_b)),
+            ):
+                prop = Proposal(
+                    height=height,
+                    round=round_,
+                    pol_round=cs.rs.valid_round,
+                    block_id=BlockID(blk.hash(), parts.header()),
+                    timestamp_ns=time.time_ns(),
+                )
+                pvs[0].sign_proposal(cs.sm_state.chain_id, prop)
+                for peer in grp:
+                    await peer.send(DATA_CHANNEL, _enc("proposal", {"proposal": prop.to_dict()}))
+                    for i in range(parts.total):
+                        await peer.send(
+                            DATA_CHANNEL,
+                            _enc("block_part", {
+                                "height": height, "round": round_,
+                                "part": parts.get_part(i).to_dict(),
+                            }),
+                        )
+
+        cs.decide_proposal = byz_decide_proposal
+        try:
+            start = max(n.block_store.height() for n in nodes)
+            # honest nodes (1-3) must keep committing identical blocks
+            await wait_all_height(nodes[1:], start + 4, timeout=60.0)
+            for h in range(1, start + 4):
+                hashes = {
+                    n.block_store.load_block(h).hash()
+                    for n in nodes[1:]
+                    if n.block_store.load_block(h) is not None
+                }
+                assert len(hashes) <= 1, f"honest nodes diverged at {h}"
+        finally:
+            await stop_net(nodes)

@@ -1,0 +1,202 @@
+"""sr25519 / ristretto255 / merlin tests (reference: crypto/sr25519).
+
+Known-answer tests pin the primitives to public vectors: the merlin
+crate's transcript equivalence vector and RFC 9496's small-multiple and
+invalid-encoding vectors — cross-implementation correctness without
+network access.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519_math as em
+from tendermint_tpu.crypto import ristretto
+from tendermint_tpu.crypto.sr25519 import Sr25519PrivKey, Sr25519PubKey
+from tendermint_tpu.crypto.strobe import Strobe128, Transcript
+
+
+class TestMerlin:
+    def test_transcript_known_answer(self):
+        """The merlin crate's test_transcript_equivalence vector."""
+        t = Transcript(b"test protocol")
+        t.append_message(b"some label", b"some data")
+        assert (
+            t.challenge_bytes(b"challenge", 32).hex()
+            == "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+        )
+
+    def test_transcripts_diverge_on_input(self):
+        t1 = Transcript(b"proto")
+        t2 = Transcript(b"proto")
+        t1.append_message(b"l", b"a")
+        t2.append_message(b"l", b"b")
+        assert t1.challenge_bytes(b"c", 32) != t2.challenge_bytes(b"c", 32)
+
+    def test_clone_is_independent(self):
+        t = Transcript(b"proto")
+        t.append_message(b"l", b"x")
+        c = t.clone()
+        c.append_message(b"l2", b"y")
+        assert t.challenge_bytes(b"c", 16) != c.challenge_bytes(b"c", 16)
+
+    def test_strobe_rejects_transport_ops(self):
+        s = Strobe128(b"x")
+        with pytest.raises(ValueError):
+            s._begin_op(0x08, False)  # FLAG_T
+
+
+RFC9496_MULTIPLES = [
+    "0000000000000000000000000000000000000000000000000000000000000000",
+    "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+    "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+    "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+    "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+    "e882b131016b52c1d3337080187cf768423efccbb517bb495ab812c4160ff44e",
+    "f64746d3c92b13050ed8d80236a7f0007c3b3f962f5ba793d19a601ebb1df403",
+    "44f53520926ec81fbd5a387845beb7df85a96a24ece18738bdcfa6a7822a176d",
+    "903293d8f2287ebe10e2374dc1a53e0bc887e592699f02d077d5263cdd55601c",
+    "02622ace8f7303a31cafc63f8fc48fdc16e1c8c8d234b2f0d6685282a9076031",
+]
+
+RFC9496_BAD = [
+    # non-canonical field elements
+    "00ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff",
+    "ecffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    # negative field elements
+    "0100000000000000000000000000000000000000000000000000000000000080",
+    "ed57ffd8c914fb201471d1c3d245ce3c746fcbe63a3679d51b6a516ebebe0e20",
+    # non-square x^2
+    "26948d35ca62e643e26a83177332e6b6afeb9d08e4268b650f1f5bbd8d81d371",
+]
+
+
+class TestRistretto:
+    def test_small_multiples_of_basepoint(self):
+        zero = (0, 1, 1, 0)
+        for i, expected in enumerate(RFC9496_MULTIPLES):
+            p = em.scalar_mult(i, ristretto.BASEPOINT) if i else zero
+            assert ristretto.encode(p).hex() == expected
+            decoded = ristretto.decode(bytes.fromhex(expected))
+            assert decoded is not None
+            assert ristretto.equals(decoded, p)
+
+    def test_bad_encodings_rejected(self):
+        for b in RFC9496_BAD:
+            assert ristretto.decode(bytes.fromhex(b)) is None
+
+    def test_encode_decode_roundtrip_random(self):
+        for i in range(1, 20):
+            p = em.scalar_mult(i * 104729 + 7, ristretto.BASEPOINT)
+            enc = ristretto.encode(p)
+            assert ristretto.encode(ristretto.decode(enc)) == enc
+
+
+class TestSr25519:
+    def test_sign_verify(self):
+        k = Sr25519PrivKey.from_secret(b"seed")
+        sig = k.sign(b"hello sr25519")
+        assert len(sig) == 64 and sig[63] & 0x80
+        assert k.pub_key().verify(b"hello sr25519", sig)
+
+    def test_reject_wrong_message_key_or_sig(self):
+        k = Sr25519PrivKey.from_secret(b"seed")
+        sig = k.sign(b"msg")
+        assert not k.pub_key().verify(b"other msg", sig)
+        assert not Sr25519PrivKey.generate().pub_key().verify(b"msg", sig)
+        bad = sig[:10] + bytes([sig[10] ^ 1]) + sig[11:]
+        assert not k.pub_key().verify(b"msg", bad)
+        # missing schnorrkel marker bit
+        unmarked = sig[:63] + bytes([sig[63] & 0x7F])
+        assert not k.pub_key().verify(b"msg", unmarked)
+
+    def test_deterministic_and_context_separated(self):
+        k = Sr25519PrivKey.from_secret(b"seed")
+        assert k.sign(b"m") == k.sign(b"m")
+        sig_other_ctx = k.sign(b"m", ctx=b"other-context")
+        assert not k.pub_key().verify(b"m", sig_other_ctx)  # default ctx
+        assert k.pub_key().verify(b"m", sig_other_ctx, ctx=b"other-context")
+
+    def test_codec_roundtrip(self):
+        from tendermint_tpu.crypto.keys import pubkey_from_dict
+
+        k = Sr25519PrivKey.generate()
+        d = k.pub_key().to_dict()
+        pk2 = pubkey_from_dict(d)
+        assert isinstance(pk2, Sr25519PubKey)
+        assert pk2.equals(k.pub_key())
+        assert len(k.pub_key().address()) == 20
+
+    def test_multisig_threshold_over_sr25519(self):
+        from tendermint_tpu.crypto.multisig import (
+            MultisigThresholdPubKey,
+            build_multisig_signature,
+        )
+        from tendermint_tpu.libs.bitarray import BitArray
+
+        keys = [Sr25519PrivKey.from_secret(b"ms%d" % i) for i in range(4)]
+        pub = MultisigThresholdPubKey(2, [k.pub_key() for k in keys])
+        msg = b"threshold payload"
+        bits = BitArray(4)
+        bits.set_index(1, True)
+        bits.set_index(3, True)
+        agg = build_multisig_signature(bits, [keys[1].sign(msg), keys[3].sign(msg)])
+        assert pub.verify(msg, agg)
+        # below threshold fails
+        bits1 = BitArray(4)
+        bits1.set_index(1, True)
+        assert not pub.verify(msg, build_multisig_signature(bits1, [keys[1].sign(msg)]))
+
+
+class TestSr25519Consensus:
+    def test_verify_commit_with_sr25519_validators(self):
+        """BASELINE config #3 core: a commit signed entirely by sr25519
+        validators verifies through ValidatorSet.verify_commit's
+        type-routed path."""
+        from tests.test_types import make_commit, make_block_id, CHAIN_ID
+        from tendermint_tpu.types import MockPV, Validator, ValidatorSet
+
+        pvs = [MockPV(priv_key=Sr25519PrivKey.from_secret(b"v%d" % i)) for i in range(6)]
+        vset = ValidatorSet([Validator.new(pv.get_pub_key(), 10) for pv in pvs])
+        pvs.sort(key=lambda pv: pv.address())
+        bid = make_block_id()
+        commit = make_commit(vset, pvs, 3, 0, bid)
+        vset.verify_commit(CHAIN_ID, bid, 3, commit)  # raises on failure
+
+    async def test_sr25519_net_commits(self, tmp_path):
+        """4 validators holding sr25519 keys reach consensus end-to-end."""
+        from tests.test_consensus_net import stop_net, wait_all_height
+        from tendermint_tpu.config import test_config as make_test_cfg
+        from tendermint_tpu.node import Node
+        from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
+
+        pvs = sorted(
+            [MockPV(priv_key=Sr25519PrivKey.from_secret(b"net%d" % i)) for i in range(4)],
+            key=lambda pv: pv.address(),
+        )
+        gen = GenesisDoc(
+            chain_id="sr-chain",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
+        )
+        nodes = []
+        for i, pv in enumerate(pvs):
+            cfg = make_test_cfg(str(tmp_path / f"sr{i}"))
+            cfg.rpc.laddr = ""
+            cfg.base.db_backend = "memdb"
+            cfg.p2p.laddr = "127.0.0.1:0"
+            cfg.consensus.skip_timeout_commit = False
+            cfg.consensus.timeout_commit = 0.1
+            nodes.append(Node(cfg, gen, priv_validator=pv, db_backend="memdb"))
+        try:
+            for node in nodes:
+                await node.start()
+            for i in range(4):
+                for j in range(i + 1, 4):
+                    addr = f"{nodes[j].node_key.id}@{nodes[j].switch.transport.listen_addr}"
+                    await nodes[i].switch.dial_peer(addr)
+            await wait_all_height(nodes, 3, timeout=60.0)
+            hashes = {n.block_store.load_block(2).hash() for n in nodes}
+            assert len(hashes) == 1
+        finally:
+            await stop_net(nodes)
