@@ -59,15 +59,22 @@ import collections as _collections
 _decompress_cache: "_collections.OrderedDict[bytes, Optional[np.ndarray]]" = (
     _collections.OrderedDict()
 )
+import threading as _threading
+
+# The cache is reached from the event-loop thread (verify_commit / lite2 via
+# the installed hook) AND the flush executor thread concurrently; an
+# unlocked check-then-act on the OrderedDict can KeyError at the eviction cap.
+_decompress_lock = _threading.Lock()
 
 
 def _neg_a_limbs(pubkey: bytes) -> Optional[np.ndarray]:
     """Decompress pubkey and return extended coords of −A as [4, 20] int32
     13-bit limbs; None for invalid encodings.  LRU-cached — validator
     pubkeys are hot across heights."""
-    if pubkey in _decompress_cache:
-        _decompress_cache.move_to_end(pubkey)
-        return _decompress_cache[pubkey]
+    with _decompress_lock:
+        if pubkey in _decompress_cache:
+            _decompress_cache.move_to_end(pubkey)
+            return _decompress_cache[pubkey]
     aff = em.decompress(pubkey)
     if aff is None:
         limbs = None
@@ -80,9 +87,10 @@ def _neg_a_limbs(pubkey: bytes) -> Optional[np.ndarray]:
             v = ext[c]
             for i in range(_N_LIMBS):
                 limbs[c, i] = (v >> (_LIMB_BITS * i)) & ((1 << _LIMB_BITS) - 1)
-    _decompress_cache[pubkey] = limbs
-    if len(_decompress_cache) > _DECOMPRESS_CACHE_MAX:
-        _decompress_cache.popitem(last=False)
+    with _decompress_lock:
+        _decompress_cache[pubkey] = limbs
+        if len(_decompress_cache) > _DECOMPRESS_CACHE_MAX:
+            _decompress_cache.popitem(last=False)
     return limbs
 
 
@@ -204,6 +212,63 @@ class BatchVerifier:
         self.batch_axis = batch_axis
         self._fn = None
         self._pallas = None  # resolved lazily: backend known only at first use
+        # Cold-start handling.  When warmup mode is on, verify() serves any
+        # bucket shape whose XLA compile hasn't landed yet from the serial
+        # host path while a background thread compiles it — a cold or
+        # restarted node never stalls consensus on a compile (the reference
+        # never stalls: crypto/ed25519/ed25519.go:151 is always ready).
+        # When off (bench, direct use), compiles run inline as before.
+        self._warmup_mode = False
+        self._ready_buckets: set = set()
+        self._compiling_buckets: set = set()
+        self._warm_lock = _threading.Lock()
+
+    def _compile_bucket(self, b: int) -> None:
+        neg_a = np.zeros((b, 4, _N_LIMBS), dtype=np.int16)
+        neg_a[:, 1, :1] = 1
+        neg_a[:, 2, :1] = 1
+        h = np.zeros((b, 64), dtype=np.uint8)
+        s = np.zeros((b, 64), dtype=np.uint8)
+        r_y = np.zeros((b, _N_LIMBS), dtype=np.int16)
+        r_s = np.zeros(b, dtype=np.uint8)
+        np.asarray(self._jitted()(neg_a, h, s, r_y, r_s))
+
+    def _bucket_ready(self, b: int) -> bool:
+        """True when bucket b may run on-device without an inline compile.
+        Otherwise kicks off (at most one) background compile for b and
+        returns False so the caller falls back to the host path.  A failed
+        compile leaves the bucket permanently on the host path rather than
+        routing traffic to a known-broken device."""
+        if not self._warmup_mode:
+            return True
+        with self._warm_lock:
+            if b in self._ready_buckets:
+                return True
+            if b in self._compiling_buckets:
+                return False
+            self._compiling_buckets.add(b)
+
+        def _compile():
+            ok = False
+            try:
+                self._compile_bucket(b)
+                ok = True
+            except Exception:
+                pass
+            with self._warm_lock:
+                self._compiling_buckets.discard(b)
+                if ok:
+                    self._ready_buckets.add(b)
+
+        _threading.Thread(target=_compile, daemon=True, name=f"bv-warmup-{b}").start()
+        return False
+
+    def start_warmup(self) -> "BatchVerifier":
+        """Enable cold-start host fallback and pre-compile the smallest
+        bucket (the shape every trickle of consensus votes lands in)."""
+        self._warmup_mode = True
+        self._bucket_ready(self._bucket(1))
+        return self
 
     def _use_pallas(self) -> bool:
         if self._pallas is None:
@@ -260,10 +325,12 @@ class BatchVerifier:
         n = len(sigs)
         if n == 0:
             return []
+        b = self._bucket(n)
+        if not self._bucket_ready(b):
+            return batch_hook.host_batch_verify(pubkeys, msgs, sigs)
         neg_a, h_digits, s_digits, r_y, r_sign, valid = prepare_batch(pubkeys, msgs, sigs)
         if not valid.any():
             return [False] * n
-        b = self._bucket(n)
         if b > n:
             neg_a = np.concatenate([neg_a, np.tile(neg_a[-1:], (b - n, 1, 1))])
         h_digits, s_digits, r_y, r_sign = _pad_scalar_rows(b, h_digits, s_digits, r_y, r_sign)
@@ -371,17 +438,29 @@ class AsyncBatchVerifier(Service):
         verifier: Optional[BatchVerifier] = None,
         max_batch: int = 4096,
         flush_interval: float = 0.002,
+        max_pending: int = 65536,
     ):
         super().__init__("batch-verifier")
         self.verifier = verifier or BatchVerifier()
         self.max_batch = max_batch
         self.flush_interval = flush_interval
+        self.max_pending = max_pending
         self._pending: List[Tuple[bytes, bytes, bytes, asyncio.Future]] = []
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
+        self._executor = None
 
     async def on_start(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
         self._wake = asyncio.Event()
+        # Jitted calls (and a cold-cache XLA compile, which is tens of
+        # seconds) must never run on the event loop: with several reactors
+        # sharing one loop an inline flush starves ping/pong, gossip and
+        # consensus timeouts — the round-4 liveness bug.  One worker keeps
+        # device dispatch serialized (the device is serial anyway).
+        self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="bv-flush")
+        self.verifier.start_warmup()  # compiles on its own thread; host path until warm
         self._task = asyncio.create_task(self._flush_loop())
 
     async def on_stop(self) -> None:
@@ -395,15 +474,25 @@ class AsyncBatchVerifier(Service):
             if not fut.done():
                 fut.cancel()
         self._pending.clear()
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
 
     def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> "asyncio.Future[bool]":
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        if len(self._pending) >= self.max_pending:
+            # Backpressure: beyond the cap, verify inline on the host path.
+            # Slower per-sig, but bounded memory and no dropped-vote false
+            # negatives (a False here would penalize an honest peer).
+            ok = batch_hook.host_batch_verify([pubkey], [msg], [sig])[0]
+            fut.set_result(bool(ok))
+            return fut
         self._pending.append((pubkey, msg, sig, fut))
         if len(self._pending) >= self.max_batch and self._wake:
             self._wake.set()
         return fut
 
     async def _flush_loop(self) -> None:
+        loop = asyncio.get_event_loop()
         while True:
             try:
                 await asyncio.wait_for(self._wake.wait(), timeout=self.flush_interval)
@@ -412,14 +501,24 @@ class AsyncBatchVerifier(Service):
             self._wake.clear()
             if not self._pending:
                 continue
-            batch, self._pending = self._pending, []
+            # chunk at max_batch so one storm doesn't produce an unbounded
+            # device shape; the remainder flushes on the next iteration
+            batch = self._pending[: self.max_batch]
+            del self._pending[: self.max_batch]
+            if len(self._pending) >= self.max_batch and self._wake:
+                self._wake.set()
             pubkeys = [b[0] for b in batch]
             msgs = [b[1] for b in batch]
             sigs = [b[2] for b in batch]
-            # The jitted call blocks this thread; consensus is itself awaiting
-            # these futures, so running inline keeps ordering deterministic.
             try:
-                results = self.verifier.verify(pubkeys, msgs, sigs)
+                results = await loop.run_in_executor(
+                    self._executor, self.verifier.verify, pubkeys, msgs, sigs
+                )
+            except asyncio.CancelledError:
+                for _, _, _, fut in batch:
+                    if not fut.done():
+                        fut.cancel()
+                raise
             except Exception as e:
                 # a dead flusher would strand every pending + future caller;
                 # fail this batch's futures and keep the loop alive

@@ -74,6 +74,46 @@ class RPCCore:
         self.timeout_broadcast_tx_commit = timeout_broadcast_tx_commit
         self.log = get_logger("rpc")
         self._sub_seq = 0
+        self._hints: Dict[str, Dict[str, Any]] = {}
+
+    def _coerce(self, method: str, handler, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Annotation-driven param conversion, mirroring the reference's
+        reflection-based URI binding (rpc/lib/server/http_uri_handler.go):
+        a quoted-string URI arg bound to a []byte param becomes raw bytes,
+        "5" binds to an int, "true" to a bool."""
+        if method not in self._hints:
+            import typing
+
+            try:
+                self._hints[method] = typing.get_type_hints(handler)
+            except Exception:
+                self._hints[method] = {}
+        hints = self._hints[method]
+        out: Dict[str, Any] = {}
+        for k, v in params.items():
+            t = hints.get(k)
+            if t is not None and getattr(t, "__origin__", None) is not None:
+                args = [a for a in getattr(t, "__args__", ()) if a is not type(None)]
+                t = args[0] if len(args) == 1 else None
+            try:
+                if t is bytes and isinstance(v, str):
+                    v = v.encode()
+                elif t is int and isinstance(v, str):
+                    v = int(v)
+                elif t is float and isinstance(v, str):
+                    v = float(v)
+                elif t is bool and isinstance(v, str):
+                    lv = v.lower()
+                    if lv in ("true", "1", "t"):
+                        v = True
+                    elif lv in ("false", "0", "f"):
+                        v = False
+                    else:  # strconv.ParseBool errors on anything else
+                        raise ValueError(v)
+            except ValueError:
+                raise RPCError(INVALID_PARAMS, f"bad value for {k!r}: {v!r}")
+            out[k] = v
+        return out
 
     async def call(self, method: str, params: Optional[Dict[str, Any]] = None) -> Any:
         if method not in self.ROUTES:
@@ -82,7 +122,7 @@ class RPCCore:
             raise RPCError(METHOD_NOT_FOUND, f"{method} requires rpc.unsafe=true")
         handler = getattr(self, method)
         try:
-            return await handler(**(params or {}))
+            return await handler(**self._coerce(method, handler, params or {}))
         except RPCError:
             raise
         except TypeError as e:

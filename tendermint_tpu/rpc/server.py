@@ -41,9 +41,11 @@ def _parse_laddr(laddr: str) -> tuple[str, int]:
 
 
 def _coerce_uri_param(v: str) -> Any:
-    """GET query params arrive as strings; mirror the reference's loose URI
-    coercion (http_uri_handler.go): quoted strings, 0x-hex bytes, ints,
-    bools, else raw string."""
+    """GET query params arrive as strings; strip quoting and decode 0x-hex
+    to bytes here, but leave everything else a string — RPCCore._coerce
+    converts by the handler's annotation (the reference likewise binds URI
+    strings by reflected arg type, http_uri_handler.go).  Eagerly guessing
+    int here would mistype e.g. tx=1234 for a bytes param."""
     if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
         return v[1:-1]
     if v.startswith("0x"):
@@ -51,12 +53,7 @@ def _coerce_uri_param(v: str) -> Any:
             return bytes.fromhex(v[2:])
         except ValueError:
             return v
-    if v in ("true", "false"):
-        return v == "true"
-    try:
-        return int(v)
-    except ValueError:
-        return v
+    return v
 
 
 class RPCServer(Service):

@@ -287,6 +287,42 @@ class TestAsyncBatchVerifier:
             await svc.stop()
 
 
+class TestWarmup:
+    def test_cold_bucket_serves_host_path_then_device(self, verifier):
+        """With warmup mode on, an uncompiled bucket shape must answer
+        correctly (host path) immediately, and flip to the device path once
+        the background compile lands — a cold node never stalls consensus."""
+        import time
+
+        pubkeys, msgs, sigs = make_sigs(3)
+        bv = BatchVerifier()
+        bv._warmup_mode = True  # no pre-compile: every bucket starts cold
+        assert bv.verify(pubkeys, msgs, sigs) == [True, True, True]
+        # a wrong signature is caught on the fallback path too
+        assert bv.verify([pubkeys[0]], [b"other"], [sigs[0]]) == [False]
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if bv._bucket(3) in bv._ready_buckets:
+                break
+            time.sleep(0.1)
+        assert bv._bucket(3) in bv._ready_buckets
+        assert bv.verify(pubkeys, msgs, sigs) == [True, True, True]
+
+    async def test_overflow_falls_back_inline(self):
+        pubkeys, msgs, sigs = make_sigs(2)
+        svc = AsyncBatchVerifier(BatchVerifier(), flush_interval=0.01, max_pending=1)
+        await svc.start()
+        try:
+            f1 = svc.verify_one(pubkeys[0], msgs[0], sigs[0])
+            f2 = svc.verify_one(pubkeys[1], msgs[1], sigs[1])  # over cap: inline host
+            assert f2.done() and f2.result() is True
+            import asyncio
+
+            assert await asyncio.wait_for(f1, 30) is True
+        finally:
+            await svc.stop()
+
+
 class TestSharded:
     def test_mesh_sharded_verify(self):
         import jax

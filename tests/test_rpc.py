@@ -159,6 +159,19 @@ class TestHTTPRoutes:
                 async with s.post(base, json=reqs) as r:
                     arr = await r.json()
                     assert len(arr) == 2
+                # quoted URI string binds to a bytes param via annotation
+                # coercion (reference http_uri_handler.go reflection)
+                async with s.get(f'{base}/broadcast_tx_sync?tx="uri=bytes"') as r:
+                    d = await r.json()
+                    assert d["result"]["code"] == 0
+                # numeric-looking string stays bytes for a bytes param
+                async with s.get(f'{base}/broadcast_tx_sync?tx="1234"') as r:
+                    d = await r.json()
+                    assert "result" in d
+                # unparseable bool errors rather than silently False
+                async with s.get(f'{base}/abci_query?data="k"&prove=yes') as r:
+                    d = await r.json()
+                    assert d["error"]["code"] == -32602
         finally:
             await node.stop()
 
