@@ -221,6 +221,7 @@ class BatchVerifier:
         self._warmup_mode = False
         self._ready_buckets: set = set()
         self._compiling_buckets: set = set()
+        self._failed_buckets: set = set()
         self._warm_lock = _threading.Lock()
 
     def _compile_bucket(self, b: int) -> None:
@@ -244,7 +245,7 @@ class BatchVerifier:
         with self._warm_lock:
             if b in self._ready_buckets:
                 return True
-            if b in self._compiling_buckets:
+            if b in self._compiling_buckets or b in self._failed_buckets:
                 return False
             self._compiling_buckets.add(b)
 
@@ -257,8 +258,7 @@ class BatchVerifier:
                 pass
             with self._warm_lock:
                 self._compiling_buckets.discard(b)
-                if ok:
-                    self._ready_buckets.add(b)
+                (self._ready_buckets if ok else self._failed_buckets).add(b)
 
         _threading.Thread(target=_compile, daemon=True, name=f"bv-warmup-{b}").start()
         return False
@@ -278,6 +278,13 @@ class BatchVerifier:
         return self._pallas
 
     def _jitted(self):
+        # called from warmup threads, the flush executor AND event-loop hook
+        # callers: without the lock two threads could build two jit objects
+        # and the warmup compile would land in a discarded instance
+        with self._warm_lock:
+            return self._jitted_locked()
+
+    def _jitted_locked(self):
         if self._fn is None:
             import jax
 

@@ -39,16 +39,21 @@ def _csrc_path() -> str:
 
 
 def _load_lib() -> Optional[ctypes.CDLL]:
-    """Compile (once, cached next to the source) and load the C batch
-    hasher; None when no toolchain is available."""
+    """Compile from the committed C source and load via ctypes; None when no
+    toolchain is available.  The artifact name embeds the source SHA-256, so
+    only a binary built from exactly this source can ever be loaded — a
+    stale, foreign, or wrong-arch .so (never committed to git) is simply a
+    cache miss and gets rebuilt."""
     global _lib, _lib_tried
     if _lib_tried:
         return _lib
     _lib_tried = True
     src = os.path.join(_csrc_path(), "sha512_batch.c")
-    so = os.path.join(_csrc_path(), "sha512_batch.so")
     try:
-        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+        with open(src, "rb") as f:
+            src_hash = hashlib.sha256(f.read()).hexdigest()[:16]
+        so = os.path.join(_csrc_path(), f"sha512_batch-{src_hash}.so")
+        if not os.path.exists(so):
             fd, tmp = tempfile.mkstemp(suffix=".so", dir=_csrc_path())
             os.close(fd)
             subprocess.run(

@@ -23,8 +23,32 @@ from .keys import PrivKey, PubKey
 from .strobe import Transcript
 from .tmhash import sum_truncated
 
-SIGNING_CTX = b"substrate"  # go-schnorrkel's default signing context
+# The reference signs with an EMPTY context: privkey.go:32 / pubkey.go:49
+# call schnorrkel.NewSigningContext([]byte{}, msg).
+SIGNING_CTX = b""
 _MARKER = 0x80  # schnorrkel "signature version" bit on s[31]
+
+
+def _expand_ed25519(mini_secret: bytes) -> tuple[int, bytes]:
+    """schnorrkel MiniSecretKey::expand_ed25519 (the mode the reference's
+    go-schnorrkel uses): h = SHA-512(mini); scalar = clamp(h[:32]) / 8
+    (ed25519-style clamp, then divide out the cofactor byte-wise); nonce =
+    h[32:].  The divided scalar is < 2^252 so it is already canonical."""
+    import hashlib
+
+    h = hashlib.sha512(mini_secret).digest()
+    key = bytearray(h[:32])
+    key[0] &= 248
+    key[31] &= 63
+    key[31] |= 64
+    # divide_scalar_bytes_by_cofactor: shift the little-endian array right
+    # 3 bits, carrying remainders downward from the most significant byte
+    low = 0
+    for i in range(31, -1, -1):
+        r = key[i] & 0b111
+        key[i] = (key[i] >> 3) + low
+        low = (r << 5) & 0xFF
+    return int.from_bytes(bytes(key), "little"), h[32:]
 
 
 def _signing_transcript(ctx: bytes, msg: bytes) -> Transcript:
@@ -100,22 +124,21 @@ class Sr25519PrivKey(PrivKey):
     TYPE = "tendermint/PrivKeySr25519"
     SIZE = 32
 
-    def __init__(self, scalar_bytes: bytes):
-        if len(scalar_bytes) != self.SIZE:
-            raise ValueError("sr25519 privkey must be a 32-byte scalar")
-        self._raw = bytes(scalar_bytes)
-        self._scalar = int.from_bytes(scalar_bytes, "little") % em.L
-        if self._scalar == 0:
-            raise ValueError("sr25519 privkey scalar is zero")
+    def __init__(self, mini_secret: bytes):
+        """The 32 bytes are a schnorrkel MiniSecretKey (what the reference
+        stores in PrivKeySr25519), NOT a raw scalar — expansion follows
+        ExpandEd25519 so derived pubkeys and signatures are wire-compatible
+        with the reference (privkey.go:26-40)."""
+        if len(mini_secret) != self.SIZE:
+            raise ValueError("sr25519 privkey must be a 32-byte mini secret")
+        self._raw = bytes(mini_secret)
+        self._scalar, self._nonce = _expand_ed25519(self._raw)
         pub_point = em.scalar_mult(self._scalar, ristretto.BASEPOINT)
         self._pub = Sr25519PubKey(ristretto.encode(pub_point))
 
     @classmethod
     def generate(cls) -> "Sr25519PrivKey":
-        while True:
-            raw = os.urandom(cls.SIZE)
-            if int.from_bytes(raw, "little") % em.L != 0:
-                return cls(raw)
+        return cls(os.urandom(cls.SIZE))
 
     @classmethod
     def from_secret(cls, secret: bytes) -> "Sr25519PrivKey":
@@ -131,10 +154,13 @@ class Sr25519PrivKey(PrivKey):
 
     def sign(self, msg: bytes, ctx: bytes = SIGNING_CTX) -> bytes:
         t = _signing_transcript(ctx, msg)
-        # deterministic nonce bound to key + transcript state (schnorrkel
-        # derives the witness from the secret nonce seed + transcript)
+        # deterministic witness bound to the expanded nonce seed +
+        # transcript state (schnorrkel derives its witness from the same
+        # nonce half of the expanded key; it additionally mixes an OS RNG,
+        # which verifiers cannot observe — determinism here is safe and
+        # keeps signing reproducible)
         wt = t.clone()
-        wt.append_message(b"nonce-seed", self._raw)
+        wt.append_message(b"nonce-seed", self._nonce)
         r = int.from_bytes(wt.challenge_bytes(b"witness", 64), "little") % em.L
         r_bytes = ristretto.encode(em.scalar_mult(r, ristretto.BASEPOINT))
         k = _challenge(t, self._pub.bytes(), r_bytes)

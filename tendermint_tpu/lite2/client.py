@@ -154,6 +154,12 @@ class Client:
         if existing is not None:
             return existing
         latest_trusted_h = self.store.latest_height()
+        # Remember what was trusted before this pass: if a witness reveals a
+        # lying primary, every header the pass persisted must be rolled back
+        # — the reference only keeps state that survived witness comparison
+        # (client.go:505-512); serving poisoned headers from the store on
+        # later calls would defeat the cross-check entirely.
+        before = set(self.store.heights())
         if height < self.store.first_height():
             sh = await self._backwards(height, now)
         elif height <= latest_trusted_h:
@@ -162,7 +168,13 @@ class Client:
             sh = await self._sequence(height, now)
         else:
             sh = await self._bisection(height, now)
-        await self._compare_with_witnesses(sh)
+        try:
+            await self._compare_with_witnesses(sh)
+        except DivergedHeaderError:
+            for h in self.store.heights():
+                if h not in before:
+                    self.store.delete(h)
+            raise
         self._prune()
         return sh
 
@@ -191,8 +203,10 @@ class Client:
                 self.chain_id, t_sh, t_vals, sh, vals,
                 self.trust_options.period_ns, now, self.max_clock_drift_ns, self.trust_level,
             )
-        self.store.save_signed_header_and_validator_set(sh, vals)
+        # witness cross-check BEFORE persisting: a diverged header must
+        # never enter the trusted store (client.go:606-612)
         await self._compare_with_witnesses(sh)
+        self.store.save_signed_header_and_validator_set(sh, vals)
         self._prune()
 
     # -- verification strategies ------------------------------------------

@@ -252,6 +252,13 @@ class FilePV(PrivValidator):
         )
         proposal.signature = sig
 
+    def sign_challenge(self, nonce: bytes) -> bytes:
+        """Connection proof-of-possession (domain-separated — cannot be
+        confused with vote/proposal bytes, so no double-sign state)."""
+        from ..types.priv_validator import challenge_sign_bytes
+
+        return self.key.priv_key.sign(challenge_sign_bytes(nonce))
+
     # -- internals ---------------------------------------------------------
 
     def _save_signed(

@@ -10,6 +10,15 @@ Wire: 4-byte big-endian length + msgpack codec frames (Vote/Proposal are
 registered types).  The signer side is async end-to-end, so an in-process
 signer (tests) shares the node's event loop without deadlock — the reason
 ConsensusState awaits PrivValidator results via _maybe_await.
+
+Transport security (privval/socket_listeners.go:80): tcp connections are
+wrapped in SecretConnection (X25519 + ChaCha20-Poly1305, each side
+authenticating with an ed25519 connection key), so the signing channel is
+encrypted and tamper-proof on the wire; `unix://` sockets rely on
+filesystem permissions, as in the reference.  On top of that the client
+pins the VALIDATOR pubkey: a reconnecting signer must present the same
+validator key or the new connection is rejected — an attacker who can
+reach priv_validator_laddr cannot hijack the channel with a fake signer.
 """
 
 from __future__ import annotations
@@ -18,7 +27,7 @@ import asyncio
 import struct
 from typing import Optional, Tuple
 
-from ..crypto.keys import PubKey, pubkey_from_dict
+from ..crypto.keys import Ed25519PrivKey, PubKey, pubkey_from_dict
 from ..encoding import codec
 from ..libs.log import get_logger
 from ..libs.service import Service
@@ -31,24 +40,53 @@ class RemoteSignerError(Exception):
     pass
 
 
-def _split_addr(addr: str) -> Tuple[str, int]:
-    addr = addr.split("://", 1)[-1]
-    host, _, port = addr.rpartition(":")
-    return host or "127.0.0.1", int(port)
+def _split_addr(addr: str) -> Tuple[str, str, int]:
+    """-> (scheme, host_or_path, port)."""
+    scheme, sep, rest = addr.partition("://")
+    if not sep:
+        scheme, rest = "tcp", addr
+    if scheme == "unix":
+        return "unix", rest, 0
+    host, _, port = rest.rpartition(":")
+    return scheme, host or "127.0.0.1", int(port)
 
 
-async def _send_frame(writer: asyncio.StreamWriter, msg: dict) -> None:
-    payload = codec.dumps(msg)
-    writer.write(struct.pack(">I", len(payload)) + payload)
-    await writer.drain()
+class _Chan:
+    """Framed message channel; plaintext (unix) or SecretConnection (tcp)."""
 
+    def __init__(self, reader, writer, secret_conn=None):
+        self._reader = reader
+        self._writer = writer
+        self._sc = secret_conn
 
-async def _read_frame(reader: asyncio.StreamReader) -> dict:
-    hdr = await reader.readexactly(4)
-    (n,) = struct.unpack(">I", hdr)
-    if n > 1 << 20:
-        raise RemoteSignerError(f"oversized privval frame ({n} bytes)")
-    return codec.loads(await reader.readexactly(n))
+    @classmethod
+    async def wrap(cls, reader, writer, scheme: str, conn_key: Ed25519PrivKey) -> "_Chan":
+        if scheme == "unix":
+            return cls(reader, writer)
+        from ..p2p.conn.secret_connection import SecretConnection
+
+        sc = await SecretConnection.make(reader, writer, conn_key)
+        return cls(reader, writer, secret_conn=sc)
+
+    async def send(self, msg: dict) -> None:
+        payload = codec.dumps(msg)
+        if self._sc is not None:
+            await self._sc.write_msg(payload)
+            return
+        self._writer.write(struct.pack(">I", len(payload)) + payload)
+        await self._writer.drain()
+
+    async def recv(self) -> dict:
+        if self._sc is not None:
+            return codec.loads(await self._sc.read_msg(1 << 20))
+        hdr = await self._reader.readexactly(4)
+        (n,) = struct.unpack(">I", hdr)
+        if n > 1 << 20:
+            raise RemoteSignerError(f"oversized privval frame ({n} bytes)")
+        return codec.loads(await self._reader.readexactly(n))
+
+    def close(self) -> None:
+        self._writer.close()
 
 
 class SignerClient(PrivValidator, Service):
@@ -66,17 +104,25 @@ class SignerClient(PrivValidator, Service):
         self.accept_timeout = accept_timeout
         self.log = get_logger("privval.client")
         self._server: Optional[asyncio.AbstractServer] = None
-        self._conn: Optional[Tuple[asyncio.StreamReader, asyncio.StreamWriter]] = None
+        self._conn: Optional[_Chan] = None
         self._conn_ready = asyncio.Event()
         self._lock = asyncio.Lock()
         self._pub_key: Optional[PubKey] = None
         self.listen_addr: str = ""
+        # fresh connection key per start, as the reference's tcp listener
+        # (privval/socket_listeners.go NewTCPListener callers)
+        self._conn_key = Ed25519PrivKey.generate()
+        self._scheme = "tcp"
 
     async def on_start(self) -> None:
-        host, port = _split_addr(self.laddr)
-        self._server = await asyncio.start_server(self._on_accept, host, port)
-        sock = self._server.sockets[0]
-        self.listen_addr = "%s:%d" % sock.getsockname()[:2]
+        self._scheme, host, port = _split_addr(self.laddr)
+        if self._scheme == "unix":
+            self._server = await asyncio.start_unix_server(self._on_accept, path=host)
+            self.listen_addr = self.laddr
+        else:
+            self._server = await asyncio.start_server(self._on_accept, host, port)
+            sock = self._server.sockets[0]
+            self.listen_addr = "%s:%d" % sock.getsockname()[:2]
         try:
             await asyncio.wait_for(self._conn_ready.wait(), self.accept_timeout)
         except asyncio.TimeoutError:
@@ -85,15 +131,48 @@ class SignerClient(PrivValidator, Service):
 
     async def on_stop(self) -> None:
         if self._conn is not None:
-            self._conn[1].close()
+            self._conn.close()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
 
     async def _on_accept(self, reader, writer) -> None:
-        if self._conn is not None:  # signer reconnected: drop the old conn
-            self._conn[1].close()
-        self._conn = (reader, writer)
+        try:
+            chan = await asyncio.wait_for(
+                _Chan.wrap(reader, writer, self._scheme, self._conn_key), self.timeout
+            )
+        except Exception as e:
+            self.log.error("signer handshake failed", err=repr(e))
+            writer.close()
+            return
+        if self._pub_key is not None:
+            # Reconnect: the new signer must PROVE possession of the SAME
+            # validator key (a fresh-nonce challenge signature, verified
+            # against the pinned pubkey) — merely stating the well-known
+            # pubkey would let anyone reaching the laddr hijack signing.
+            import os as _os
+
+            from ..types.priv_validator import challenge_sign_bytes
+
+            nonce = _os.urandom(32)
+            try:
+                await chan.send({"t": "challenge_req", "nonce": nonce})
+                resp = await asyncio.wait_for(chan.recv(), self.timeout)
+                sig = resp["sig"]
+                ok = self._pub_key.verify(challenge_sign_bytes(nonce), sig)
+            except Exception as e:
+                self.log.error("reconnect challenge probe failed", err=repr(e))
+                chan.close()
+                return
+            if not ok:
+                self.log.error(
+                    "reconnecting signer failed validator-key proof of possession; rejecting"
+                )
+                chan.close()
+                return
+        if self._conn is not None:  # accepted replacement: drop the old conn
+            self._conn.close()
+        self._conn = chan
         self._conn_ready.set()
         self.log.info("remote signer connected")
 
@@ -101,9 +180,9 @@ class SignerClient(PrivValidator, Service):
         async with self._lock:
             if self._conn is None:
                 raise RemoteSignerError("no signer connection")
-            reader, writer = self._conn
-            await _send_frame(writer, msg)
-            resp = await asyncio.wait_for(_read_frame(reader), self.timeout)
+            conn = self._conn
+            await conn.send(msg)
+            resp = await asyncio.wait_for(conn.recv(), self.timeout)
         if resp.get("t") == "error":
             raise RemoteSignerError(resp.get("err", "unknown remote signer error"))
         return resp
@@ -159,22 +238,26 @@ class SignerServer(Service):
         self.retry_interval = retry_interval
         self.log = get_logger("privval.server")
         self._task: Optional[asyncio.Task] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
+        self._chan: Optional[_Chan] = None
+        self._conn_key = Ed25519PrivKey.generate()
 
     async def on_start(self) -> None:
-        host, port = _split_addr(self.laddr)
+        scheme, host, port = _split_addr(self.laddr)
         last_err: Optional[Exception] = None
         for _ in range(self.retries):
             try:
-                reader, writer = await asyncio.open_connection(host, port)
+                if scheme == "unix":
+                    reader, writer = await asyncio.open_unix_connection(host)
+                else:
+                    reader, writer = await asyncio.open_connection(host, port)
                 break
             except OSError as e:
                 last_err = e
                 await asyncio.sleep(self.retry_interval)
         else:
             raise RemoteSignerError(f"cannot dial {self.laddr}: {last_err}")
-        self._writer = writer
-        self._task = asyncio.create_task(self._serve(reader, writer))
+        self._chan = await _Chan.wrap(reader, writer, scheme, self._conn_key)
+        self._task = asyncio.create_task(self._serve(self._chan))
 
     async def on_stop(self) -> None:
         if self._task is not None:
@@ -183,13 +266,13 @@ class SignerServer(Service):
                 await self._task
             except asyncio.CancelledError:
                 pass
-        if self._writer is not None:
-            self._writer.close()
+        if self._chan is not None:
+            self._chan.close()
 
-    async def _serve(self, reader, writer) -> None:
+    async def _serve(self, chan: _Chan) -> None:
         while True:
             try:
-                req = await _read_frame(reader)
+                req = await chan.recv()
             except (asyncio.IncompleteReadError, ConnectionError):
                 self.log.info("node connection closed")
                 return
@@ -197,7 +280,7 @@ class SignerServer(Service):
                 resp = self._handle(req)
             except Exception as e:  # double-sign refusals travel as errors
                 resp = {"t": "error", "err": str(e)}
-            await _send_frame(writer, resp)
+            await chan.send(resp)
 
     def _handle(self, req: dict) -> dict:
         kind = req.get("t")
@@ -205,6 +288,8 @@ class SignerServer(Service):
             return {"t": "pong"}
         if kind == "pubkey_req":
             return {"t": "pubkey_resp", "pubkey": self.pv.get_pub_key().to_dict()}
+        if kind == "challenge_req":
+            return {"t": "challenge_resp", "sig": self.pv.sign_challenge(req["nonce"])}
         if kind == "sign_vote_req":
             vote: Vote = req["vote"]
             self.pv.sign_vote(req["chain_id"], vote)

@@ -13,6 +13,18 @@ from .proposal import Proposal
 from .vote import Vote
 
 
+# Domain separator for connection-liveness challenges (remote signer
+# proof-of-possession).  Distinct from any canonical vote/proposal
+# encoding, so a challenge signature can never be replayed as a vote.
+CHALLENGE_PREFIX = b"\x00\x00privval-conn-challenge\x00"
+
+
+def challenge_sign_bytes(nonce: bytes) -> bytes:
+    if len(nonce) != 32:
+        raise ValueError("challenge nonce must be 32 bytes")
+    return CHALLENGE_PREFIX + nonce
+
+
 class PrivValidator(ABC):
     """Signs votes and proposals, never double-signs."""
 
@@ -25,6 +37,11 @@ class PrivValidator(ABC):
 
     @abstractmethod
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> None: ...
+
+    def sign_challenge(self, nonce: bytes) -> bytes:
+        """Prove possession of the validator key over a fresh nonce
+        (domain-separated; used by SignerClient reconnect pinning)."""
+        raise NotImplementedError
 
 
 class MockPV(PrivValidator):
@@ -50,6 +67,9 @@ class MockPV(PrivValidator):
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
         use_chain_id = "incorrect-chain-id" if self.break_proposal_signing else chain_id
         proposal.signature = self.priv_key.sign(proposal.sign_bytes(use_chain_id))
+
+    def sign_challenge(self, nonce: bytes) -> bytes:
+        return self.priv_key.sign(challenge_sign_bytes(nonce))
 
     def __repr__(self) -> str:
         return f"MockPV({self.address().hex()[:12]})"

@@ -204,6 +204,13 @@ class TestClient:
         c = mk_client(headers, vals, witnesses=[forked])
         with pytest.raises(DivergedHeaderError):
             await c.verify_header_at_height(10)
+        # the lying primary's headers were rolled back, not left trusted:
+        # only the trust-root height may remain in the store, and repeat
+        # queries keep failing rather than serving the poisoned header
+        assert c.store.signed_header(10) is None
+        assert all(h == 1 for h in c.store.heights())
+        with pytest.raises(DivergedHeaderError):
+            await c.verify_header_at_height(10)
 
     async def test_replace_primary(self, tmp_path):
         vset, pvs = rand_vset(4)

@@ -156,6 +156,86 @@ class TestRemoteSigner:
             await server.stop()
             await client.stop()
 
+    async def test_tcp_channel_is_encrypted(self, tmp_path):
+        """tcp privval runs over SecretConnection (socket_listeners.go:80):
+        sign-bytes must never appear in plaintext on the wire."""
+        file_pv = FilePV.load_or_generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"))
+        client = SignerClient("127.0.0.1:0", accept_timeout=10.0)
+        start_task = asyncio.ensure_future(client.start())
+        await asyncio.sleep(0.05)
+        server = SignerServer(client.listen_addr, file_pv)
+        await server.start()
+        await start_task
+        try:
+            assert client._conn._sc is not None  # SecretConnection active
+            assert server._chan._sc is not None
+        finally:
+            await server.stop()
+            await client.stop()
+
+    async def test_reconnect_with_different_key_rejected(self, tmp_path):
+        """An attacker who can reach priv_validator_laddr must not be able
+        to replace the established signer with their own key."""
+        real_pv = FilePV.load_or_generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"))
+        client = SignerClient("127.0.0.1:0", accept_timeout=10.0, timeout=2.0)
+        start_task = asyncio.ensure_future(client.start())
+        await asyncio.sleep(0.05)
+        server = SignerServer(client.listen_addr, real_pv)
+        await server.start()
+        await start_task
+        attacker_pv = FilePV.load_or_generate(
+            str(tmp_path / "ak.json"), str(tmp_path / "as.json")
+        )
+        attacker = SignerServer(client.listen_addr, attacker_pv)
+
+        # also: an attacker CLAIMING the victim's pubkey (it is public!)
+        # must fail the proof-of-possession challenge
+        class _ClaimingPV:
+            def get_pub_key(self):
+                return real_pv.get_pub_key()  # stated, not possessed
+
+            def sign_challenge(self, nonce):
+                return b"\x00" * 64  # cannot actually sign
+
+            def sign_vote(self, chain_id, vote):
+                vote.signature = b"\x00" * 64
+
+            def sign_proposal(self, chain_id, proposal):
+                proposal.signature = b"\x00" * 64
+
+        claiming = SignerServer(client.listen_addr, _ClaimingPV())
+        try:
+            await attacker.start()
+            await claiming.start()
+            await asyncio.sleep(0.3)  # give the probes time to run + reject
+            # the original signer still serves; signing still uses the real key
+            v = mk_vote(real_pv)
+            await client.sign_vote(CHAIN, v)
+            assert real_pv.get_pub_key().verify(v.sign_bytes(CHAIN), v.signature)
+            assert client.get_pub_key().bytes() == real_pv.get_pub_key().bytes()
+        finally:
+            await attacker.stop()
+            await claiming.stop()
+            await server.stop()
+            await client.stop()
+
+    async def test_unix_socket_roundtrip(self, tmp_path):
+        file_pv = FilePV.load_or_generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"))
+        sock = str(tmp_path / "pv.sock")
+        client = SignerClient(f"unix://{sock}", accept_timeout=10.0)
+        start_task = asyncio.ensure_future(client.start())
+        await asyncio.sleep(0.05)
+        server = SignerServer(f"unix://{sock}", file_pv)
+        await server.start()
+        await start_task
+        try:
+            v = mk_vote(file_pv)
+            await client.sign_vote(CHAIN, v)
+            assert file_pv.get_pub_key().verify(v.sign_bytes(CHAIN), v.signature)
+        finally:
+            await server.stop()
+            await client.stop()
+
     async def test_node_runs_with_remote_signer(self, tmp_path):
         """Solo validator produces blocks with signing delegated over the
         privval socket (the node/node.go:612 configuration)."""

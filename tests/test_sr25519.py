@@ -93,6 +93,30 @@ class TestRistretto:
 
 
 class TestSr25519:
+    def test_expand_ed25519_known_answer(self):
+        """Cross-implementation KAT: the substrate //Alice dev account.
+        schnorrkel MiniSecretKey(e5be...) expanded with ExpandEd25519 (the
+        mode the reference's go-schnorrkel uses, privkey.go:31) derives the
+        canonical Alice public key — proving key derivation, ristretto
+        encoding and scalar math agree with curve25519-dalek/schnorrkel."""
+        mini = bytes.fromhex(
+            "e5be9a5092b81bca64be81d212e7f2f9eba183bb7a90954f7b76361f6edb5c0a"
+        )
+        pub = Sr25519PrivKey(mini).pub_key().bytes()
+        assert pub == bytes.fromhex(
+            "d43593c715fdd31c61141abd04a99fd6822c8558854ccde39a5684e7a56da27d"
+        )
+
+    def test_default_context_is_empty(self):
+        """The reference signs with NewSigningContext([]byte{}, msg)
+        (pubkey.go:49) — a b'substrate' context would diverge on the wire."""
+        from tendermint_tpu.crypto.sr25519 import SIGNING_CTX
+
+        assert SIGNING_CTX == b""
+        k = Sr25519PrivKey.from_secret(b"seed")
+        sig = k.sign(b"m")
+        assert k.pub_key().verify(b"m", sig, ctx=b"")
+
     def test_sign_verify(self):
         k = Sr25519PrivKey.from_secret(b"seed")
         sig = k.sign(b"hello sr25519")
