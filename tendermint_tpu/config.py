@@ -147,6 +147,7 @@ class TPUConfig:
     flush_interval: float = 0.002  # async batcher deadline (seconds)
     max_batch: int = 4096
     mesh_devices: int = 0  # 0 = single device; N>1 shards the batch axis
+    min_device_batch: int = 16  # below this, serial host verify wins
 
 
 @dataclass

@@ -99,6 +99,10 @@ class ConsensusState(Service):
         self.wal = NilWAL()
         self.do_wal_catchup = True
         self.replay_mode = False
+        from ..libs.metrics import ConsensusMetrics
+
+        self.metrics = ConsensusMetrics()  # nop; node swaps in prometheus
+        self._total_txs = 0
 
         # the round state
         self.rs = RoundState()
@@ -676,6 +680,7 @@ class ConsensusState(Service):
             seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
             self.block_store.save_block(block, block_parts, seen_commit)
         fail_point("finalize-saved-block")
+        self._record_metrics(block)
 
         # end-height marker implies the block store has the block (wal.go:46)
         self.wal.write_end_height(height)
@@ -702,6 +707,37 @@ class ConsensusState(Service):
 
     def state_prune(self, retain_height: int) -> None:
         self.block_exec.state_store.prune_states(retain_height)
+
+    def _record_metrics(self, block) -> None:
+        """consensus/state.go:1458 recordMetrics."""
+        m = self.metrics
+        rs = self.rs
+        try:
+            m.height.set(block.height)
+            vals = rs.validators
+            m.validators.set(vals.size())
+            m.validators_power.set(vals.total_voting_power())
+            pre = rs.votes.precommits(rs.commit_round)
+            missing = missing_power = 0
+            for i, v in enumerate(vals.validators):
+                if pre.get_by_index(i) is None:
+                    missing += 1
+                    missing_power += v.voting_power
+            m.missing_validators.set(missing)
+            m.missing_validators_power.set(missing_power)
+            m.rounds.set(rs.round)
+            m.num_txs.set(len(block.txs))
+            self._total_txs += len(block.txs)
+            m.total_txs.set(self._total_txs)
+            m.block_size_bytes.set(sum(len(tx) for tx in block.txs))
+            m.committed_height.set(block.height)
+            prev = self.block_store.load_block_meta(block.height - 1)
+            if prev is not None:
+                m.block_interval_seconds.observe(
+                    max(0.0, (block.header.time_ns - prev.header.time_ns) / 1e9)
+                )
+        except Exception as e:  # metrics must never break consensus
+            self.log.error("record metrics failed", err=repr(e))
 
     # ------------------------------------------------------------------
     # proposal + block parts

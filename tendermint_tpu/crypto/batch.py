@@ -45,3 +45,26 @@ def get_verifier() -> BatchVerifyFn:
 def set_verifier(fn: Optional[BatchVerifyFn]) -> None:
     global _verifier
     _verifier = fn
+
+
+# Indexed commit verification: callers that know (validator-set key, row
+# indices) — verify_commit and friends — can route through a per-valset
+# device table (HBM pubkey rows / precomputed window tables) instead of
+# shipping pubkeys every call.  fn(set_key, pubkeys, idxs, msgs, sigs)
+# returns list[bool], or None to decline (engine cold / set too large),
+# in which case the caller falls back to the flat batch verifier.
+IndexedVerifyFn = Callable[
+    [bytes, Sequence[bytes], Sequence[int], Sequence[bytes], Sequence[bytes]],
+    Optional[List[bool]],
+]
+
+_indexed_verifier: Optional[IndexedVerifyFn] = None
+
+
+def get_indexed_verifier() -> Optional[IndexedVerifyFn]:
+    return _indexed_verifier
+
+
+def set_indexed_verifier(fn: Optional[IndexedVerifyFn]) -> None:
+    global _indexed_verifier
+    _indexed_verifier = fn

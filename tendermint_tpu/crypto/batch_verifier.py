@@ -142,13 +142,10 @@ def _scalar_rows(
     r_le = np.frombuffer(b"".join(r_parts), dtype=np.uint8).reshape(n, 32)
     # canonical-S prefilter, vectorized (was a per-item bigint compare)
     valid &= hostprep.sc_minimal_rows(s_le)
-    # batch SHA-512 h = H(R‖A‖M) via the C extension + mod-L reduce
-    h_parts: list = [zeros32] * n
+    # h = SHA-512(R‖A‖M) mod L: one fused C pass (hash + Barrett reduce)
+    h_le = np.zeros((n, 32), dtype=np.uint8)
     if hash_parts:
-        digests = hostprep.sha512_batch(hash_parts)
-        for pos, hb in zip(hash_pos, hostprep.reduce_mod_l(digests)):
-            h_parts[pos] = hb
-    h_le = np.frombuffer(b"".join(h_parts), dtype=np.uint8).reshape(n, 32)
+        h_le[hash_pos] = hostprep.sha512_mod_l(hash_parts)
     r_y_raw, r_sign = _r_limbs_and_sign(r_le)
     return _msb_digits(h_le), _msb_digits(s_le), r_y_raw, r_sign, valid
 
@@ -195,6 +192,7 @@ def prepare_batch(
 
 
 _PALLAS_TILE = 512  # best-measured batch tile (sublane 20 x lane 512 blocks)
+_CHUNK = 2048  # double-buffer chunk for large single-shot indexed batches
 
 
 class BatchVerifier:
@@ -207,9 +205,14 @@ class BatchVerifier:
     portable XLA kernel (ops/ed25519.py) is used instead.
     """
 
-    def __init__(self, mesh=None, batch_axis: str = "batch"):
+    def __init__(self, mesh=None, batch_axis: str = "batch", min_device_batch: int = 1):
         self.mesh = mesh
         self.batch_axis = batch_axis
+        # Batches below this ride the serial host path: a tiny batch's
+        # device dispatch (dominated by host<->device RTT on remote-attached
+        # TPUs) costs more than ~0.15 ms/sig host verification.  1 = always
+        # device (bench/tests); nodes set it from config (tpu.min_device_batch).
+        self.min_device_batch = min_device_batch
         self._fn = None
         self._pallas = None  # resolved lazily: backend known only at first use
         # Cold-start handling.  When warmup mode is on, verify() serves any
@@ -260,7 +263,10 @@ class BatchVerifier:
                 self._compiling_buckets.discard(b)
                 (self._ready_buckets if ok else self._failed_buckets).add(b)
 
-        _threading.Thread(target=_compile, daemon=True, name=f"bv-warmup-{b}").start()
+        # non-daemon: a daemon thread killed mid-XLA-compile at interpreter
+        # exit aborts the whole process from C++ ("terminate called");
+        # joining at exit costs at most one compile
+        _threading.Thread(target=_compile, daemon=False, name=f"bv-warmup-{b}").start()
         return False
 
     def start_warmup(self) -> "BatchVerifier":
@@ -332,6 +338,8 @@ class BatchVerifier:
         n = len(sigs)
         if n == 0:
             return []
+        if n < self.min_device_batch:
+            return batch_hook.host_batch_verify(pubkeys, msgs, sigs)
         b = self._bucket(n)
         if not self._bucket_ready(b):
             return batch_hook.host_batch_verify(pubkeys, msgs, sigs)
@@ -354,9 +362,28 @@ class BatchVerifier:
 class PubkeyTable:
     """HBM-resident decompressed validator pubkey table, keyed by validator
     index — commits verify by gathering rows on-device (the BASELINE.json
-    north star).  Rebuilt only on validator-set changes."""
+    north star).  Rebuilt only on validator-set changes.
 
-    def __init__(self, pubkeys: Sequence[bytes], verifier: Optional[BatchVerifier] = None):
+    `tabulated=True` additionally precomputes per-validator window tables
+    (ops/ed25519_table.py: table[v, w, d] = d·16^w·(−A_v)) so steady-state
+    commit verification needs ZERO point doublings — 128 gathered adds per
+    signature instead of the 384-op Straus ladder.
+
+    MEASURED AND KEPT OPT-IN: on v5e the gather is the bottleneck, not the
+    VPU — 128 random 160 B table rows per signature (≈2 GB effective HBM
+    traffic per 10k batch after layout) make the tabulated path 85 ms
+    steady-state vs 31 ms for the VMEM-resident ladder (BENCH r5).  The
+    zero-doubling math only pays off if the gather can be made sequential;
+    until then the ladder remains the default device path."""
+
+    TABULATED_MAX_VALIDATORS = 16384  # ~2.6 GB of HBM tables
+
+    def __init__(
+        self,
+        pubkeys: Sequence[bytes],
+        verifier: Optional[BatchVerifier] = None,
+        tabulated: Optional[bool] = None,
+    ):
         import jax.numpy as jnp
 
         self.verifier = verifier or BatchVerifier()
@@ -373,6 +400,23 @@ class PubkeyTable:
                 self.row_valid[i] = True
         self.neg_a_rows = jnp.asarray(rows)  # device-resident
         self._fused_fn = None
+        if tabulated is None:
+            tabulated = False  # ladder wins on v5e; see class docstring
+        if tabulated and n > self.TABULATED_MAX_VALIDATORS:
+            tabulated = False
+        self.tabulated = tabulated
+        self._window_tables = None
+        self._interpret = False  # CPU-interpret pallas (tests only)
+
+    def build_tables(self):
+        """One-time per validator set: device-built window tables
+        (~seconds, amortized over every commit until the set changes)."""
+        if self._window_tables is None:
+            from ..ops import ed25519_table
+
+            self._window_tables = ed25519_table.build_window_tables(self.neg_a_rows)
+            self._window_tables.block_until_ready()
+        return self._window_tables
 
     def __len__(self) -> int:
         return len(self.pubkeys)
@@ -401,18 +445,76 @@ class PubkeyTable:
         n = len(sigs)
         if n == 0:
             return []
+        pk_count = len(self.pubkeys)
+        if n < self.verifier.min_device_batch:
+            return batch_hook.host_batch_verify(
+                [
+                    self.pubkeys[i] if 0 <= i < pk_count else b""
+                    for i in (int(i) for i in idxs)
+                ],
+                msgs,
+                sigs,
+            )
         idx_arr = np.asarray(idxs, dtype=np.int32)
         # Host prep for everything except pubkey limbs (gathered on device);
         # entries with bad indices are marked invalid up front.
         items: list = [None] * n
-        pk_count = len(self.pubkeys)
         idx_list = idx_arr.tolist()
         for i, (idx, msg, sig) in enumerate(zip(idx_list, msgs, sigs)):
             if 0 <= idx < pk_count and self.row_valid[idx]:
                 items[i] = (self.pubkeys[idx], msg, sig)
+
+        if not self.tabulated and n >= 2 * _CHUNK:
+            # Double-buffered single-shot: device dispatch is async, so
+            # prepping chunk k+1 on the host while the device runs chunk k
+            # hides most of the host prep inside device time — single-shot
+            # latency ≈ prep(chunk 1) + device(total) instead of
+            # prep(total) + device(total).
+            fn = self._fused()
+            pending = []
+            for start in range(0, n, _CHUNK):
+                end = min(start + _CHUNK, n)
+                h, s, ry, rs, valid_c = _scalar_rows(items[start:end])
+                cnt = end - start
+                h, s, ry, rs = _pad_scalar_rows(_CHUNK, h, s, ry, rs)
+                idx_c = idx_arr[start:end]
+                if cnt < _CHUNK:
+                    idx_c = np.concatenate([idx_c, np.zeros(_CHUNK - cnt, np.int32)])
+                idx_c = np.clip(idx_c, 0, pk_count - 1)
+                pending.append((fn(self.neg_a_rows, idx_c, h, s, ry, rs), valid_c, cnt))
+            out: List[bool] = []
+            for dev_ok, valid_c, cnt in pending:
+                out.extend(np.logical_and(np.asarray(dev_ok)[:cnt], valid_c).tolist())
+            return out
+
         h_digits, s_digits, r_y, r_sign, valid = _scalar_rows(items)
         if not valid.any():
             return [False] * n
+
+        if self.tabulated:
+            from ..ops import ed25519_table
+
+            tile = min(_PALLAS_TILE, 256)
+            b = ((n + tile - 1) // tile) * tile
+            h_digits, s_digits, r_y, r_sign = _pad_scalar_rows(
+                b, h_digits, s_digits, r_y, r_sign
+            )
+            if b > n:
+                idx_arr = np.concatenate([idx_arr, np.zeros(b - n, dtype=np.int32)])
+            idx_arr = np.clip(idx_arr, 0, pk_count - 1)
+            ok = np.asarray(
+                ed25519_table.verify_tabulated(
+                    self.build_tables(),
+                    idx_arr,
+                    h_digits,
+                    s_digits,
+                    r_y,
+                    r_sign,
+                    tile=tile,
+                    interpret=self._interpret,
+                )
+            )[:n]
+            return list(np.logical_and(ok, valid))
 
         b = self.verifier._bucket(n)
         h_digits, s_digits, r_y, r_sign = _pad_scalar_rows(b, h_digits, s_digits, r_y, r_sign)
@@ -423,6 +525,112 @@ class PubkeyTable:
             self._fused()(self.neg_a_rows, idx_arr, h_digits, s_digits, r_y, r_sign)
         )[:n]
         return list(np.logical_and(ok, valid))
+
+
+class TableCache:
+    """Per-validator-set device tables for indexed commit verification.
+
+    verify_commit knows (validator-set hash, row indices); routing through
+    this cache lets the steady-state commit path gather pubkey rows (and,
+    tabulated, precomputed window tables) on-device instead of shipping
+    pubkeys every call.  Keyed by the set hash; small LRU — consensus
+    touches at most current + last validator sets, lite2 a few more.
+
+    Installed process-wide via `install()` (crypto.batch.set_indexed_verifier);
+    returns None (declining, caller falls back to the flat batch) while the
+    engine is cold or when a set exceeds the table budget.
+    """
+
+    def __init__(
+        self,
+        verifier: Optional[BatchVerifier] = None,
+        max_sets: int = 4,
+        tabulated: Optional[bool] = None,
+    ):
+        self.verifier = verifier or BatchVerifier()
+        self.max_sets = max_sets
+        self.tabulated = tabulated
+        self._tables: "_collections.OrderedDict[bytes, PubkeyTable]" = (
+            _collections.OrderedDict()
+        )
+        self._building: set = set()
+        self._lock = _threading.Lock()
+
+    def table_for(self, set_key: bytes, pubkeys: Sequence[bytes]) -> PubkeyTable:
+        """Get-or-build synchronously (bench / direct use)."""
+        with self._lock:
+            tab = self._tables.get(set_key)
+            if tab is not None:
+                self._tables.move_to_end(set_key)
+                return tab
+        tab = PubkeyTable(pubkeys, verifier=self.verifier, tabulated=self.tabulated)
+        if tab.tabulated:
+            tab.build_tables()
+        with self._lock:
+            self._tables[set_key] = tab
+            if len(self._tables) > self.max_sets:
+                self._tables.popitem(last=False)
+        return tab
+
+    def verify_indexed(
+        self,
+        set_key: bytes,
+        pubkeys: Sequence[bytes],
+        idxs: Sequence[int],
+        msgs: Sequence[bytes],
+        sigs: Sequence[bytes],
+    ) -> Optional[List[bool]]:
+        with self._lock:
+            tab = self._tables.get(set_key)
+            if tab is not None:
+                self._tables.move_to_end(set_key)
+        if tab is not None:
+            return tab.verify_indexed(idxs, msgs, sigs)
+        if not self.verifier._warmup_mode:
+            return self.table_for(set_key, self._rows(pubkeys)).verify_indexed(idxs, msgs, sigs)
+        # Node mode: building (decompress + device table compile, seconds at
+        # 10k validators) must not stall the event loop — build in the
+        # background once and decline meanwhile; the flat batch path (with
+        # its own cold fallback) serves until the table is ready.
+        with self._lock:
+            if set_key in self._building:
+                return None
+            self._building.add(set_key)
+        pk_copy = [bytes(pk) for pk in self._rows(pubkeys)]
+        n_hint = max(len(sigs), 1)
+
+        def _build():
+            try:
+                tab = self.table_for(set_key, pk_copy)
+                # Warm the verify pipeline at the shape this commit size
+                # will use — otherwise the first post-build verify_commit
+                # jit-compiles inline on the consensus event loop, the very
+                # stall the decline-while-cold dance exists to avoid.
+                tab.verify_indexed(
+                    [i % max(len(pk_copy), 1) for i in range(n_hint)],
+                    [b"warmup"] * n_hint,
+                    [bytes(64)] * n_hint,
+                )
+            except Exception:
+                pass
+            finally:
+                with self._lock:
+                    self._building.discard(set_key)
+
+        # non-daemon for the same reason as the warmup threads above
+        _threading.Thread(target=_build, daemon=False, name="table-build").start()
+        return None
+
+    @staticmethod
+    def _rows(pubkeys) -> Sequence[bytes]:
+        """Accept either materialized rows or a lazy thunk — the steady
+        state (cache hit) never needs the rows, so hot callers pass a
+        callable and skip building a V-sized list per commit."""
+        return pubkeys() if callable(pubkeys) else pubkeys
+
+    def install(self) -> "TableCache":
+        batch_hook.set_indexed_verifier(self.verify_indexed)
+        return self
 
 
 # ---------------------------------------------------------------------------

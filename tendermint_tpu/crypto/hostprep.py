@@ -64,17 +64,40 @@ def _load_lib() -> Optional[ctypes.CDLL]:
             )
             os.replace(tmp, so)
         lib = ctypes.CDLL(so)
-        lib.sha512_batch.argtypes = [
+        argtypes = [
             ctypes.c_char_p,
             np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
             ctypes.c_uint64,
             np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
         ]
+        lib.sha512_batch.argtypes = argtypes
         lib.sha512_batch.restype = None
+        lib.sha512_mod_l_batch.argtypes = argtypes
+        lib.sha512_mod_l_batch.restype = None
         _lib = lib
     except Exception:
         _lib = None
     return _lib
+
+
+def sha512_mod_l(parts: Sequence[bytes]) -> np.ndarray:
+    """[n, 32] uint8 little-endian h = SHA-512(item) mod L per item — the
+    whole hash+reduce host step in one C pass (Barrett, see sha512_batch.c);
+    hashlib + Python-int fallback without a toolchain."""
+    n = len(parts)
+    lib = _load_lib()
+    if lib is None:
+        out = np.empty((n, 32), dtype=np.uint8)
+        for i, p in enumerate(parts):
+            h = int.from_bytes(hashlib.sha512(p).digest(), "little") % em.L
+            out[i] = np.frombuffer(h.to_bytes(32, "little"), dtype=np.uint8)
+        return out
+    buf = b"".join(parts)
+    offs = np.zeros(n + 1, dtype=np.uint64)
+    np.cumsum([len(p) for p in parts], out=offs[1:])
+    out = np.empty((n, 32), dtype=np.uint8)
+    lib.sha512_mod_l_batch(buf, offs, n, out)
+    return out
 
 
 def sha512_batch(parts: Sequence[bytes]) -> np.ndarray:

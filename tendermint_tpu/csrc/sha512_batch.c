@@ -110,3 +110,100 @@ void sha512_batch(const uint8_t *buf, const uint64_t *offs, uint64_t n,
   for (uint64_t i = 0; i < n; i++)
     sha512_one(buf + offs[i], offs[i + 1] - offs[i], out + 64 * i);
 }
+
+/* ---- scalar reduction mod the ed25519 group order L ---------------------
+ *
+ * Barrett reduction (HAC 14.42, b = 2^64, k = 4) of the 512-bit digest to
+ * h mod L.  Replaces a ~0.7 us/item Python bigint loop that cost ~7 ms on
+ * a 10k-signature commit batch.  Constants below are
+ *   L  = 2^252 + 27742317777372353535851937790883648493
+ *   mu = floor(2^512 / L)
+ * differential-tested against Python int arithmetic in tests/test_crypto.py.
+ */
+
+static const uint64_t L_LIMBS[4] = {0x5812631a5cf5d3edULL, 0x14def9dea2f79cd6ULL,
+                                    0x0ULL, 0x1000000000000000ULL};
+static const uint64_t MU_LIMBS[5] = {0xed9ce5a30a2c131bULL, 0x2106215d086329a7ULL,
+                                     0xffffffffffffffebULL, 0xffffffffffffffffULL,
+                                     0xfULL};
+
+/* r[0..9] = a[0..4] * b[0..4] (truncated at 10 limbs; exact here) */
+static void mul5x5(const uint64_t *a, const uint64_t *b, uint64_t *r) {
+  unsigned __int128 acc = 0;
+  for (int k = 0; k < 10; k++) {
+    uint64_t carry_hi = 0;
+    for (int i = 0; i < 5; i++) {
+      int j = k - i;
+      if (j < 0 || j > 4) continue;
+      unsigned __int128 prev = acc;
+      acc += (unsigned __int128)a[i] * b[j];
+      if (acc < prev) carry_hi++; /* 128-bit overflow into the next-next limb */
+    }
+    r[k] = (uint64_t)acc;
+    acc = (acc >> 64) | ((unsigned __int128)carry_hi << 64);
+  }
+}
+
+/* out32 = x (8 LE limbs) mod L, little-endian bytes */
+static void mod_l(const uint64_t x[8], uint8_t out32[32]) {
+  /* q1 = x / b^3: limbs x[3..7] */
+  uint64_t q1[5];
+  for (int i = 0; i < 5; i++) q1[i] = x[i + 3];
+  /* q2 = q1 * mu (10 limbs); q3 = q2 / b^5 */
+  uint64_t q2[10];
+  mul5x5(q1, MU_LIMBS, q2);
+  const uint64_t *q3 = q2 + 5;
+  /* r2 = (q3 * L) mod b^5 */
+  uint64_t lw[5] = {L_LIMBS[0], L_LIMBS[1], L_LIMBS[2], L_LIMBS[3], 0};
+  uint64_t q3w[5] = {q3[0], q3[1], q3[2], q3[3], q3[4]};
+  uint64_t prod[10];
+  mul5x5(q3w, lw, prod);
+  /* r = (x mod b^5) - r2, mod b^5 (borrow beyond limb 4 is discarded) */
+  uint64_t r[5];
+  unsigned __int128 borrow = 0;
+  for (int i = 0; i < 5; i++) {
+    unsigned __int128 d = (unsigned __int128)x[i] - prod[i] - borrow;
+    r[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  /* at most two conditional subtractions of L */
+  for (int iter = 0; iter < 3; iter++) {
+    /* r >= L ? (r has 5 limbs; L has 4) */
+    int ge = 1;
+    if (r[4] == 0) {
+      ge = 0;
+      for (int i = 3; i >= 0; i--) {
+        if (r[i] > L_LIMBS[i]) { ge = 1; break; }
+        if (r[i] < L_LIMBS[i]) { ge = 0; break; }
+        if (i == 0) ge = 1; /* equal */
+      }
+    }
+    if (!ge) break;
+    unsigned __int128 bw = 0;
+    for (int i = 0; i < 5; i++) {
+      uint64_t li = (i < 4) ? L_LIMBS[i] : 0;
+      unsigned __int128 d = (unsigned __int128)r[i] - li - bw;
+      r[i] = (uint64_t)d;
+      bw = (d >> 64) ? 1 : 0;
+    }
+  }
+  for (int i = 0; i < 4; i++)
+    for (int j = 0; j < 8; j++) out32[8 * i + j] = (uint8_t)(r[i] >> (8 * j));
+}
+
+/* Hash n concatenated messages and reduce each digest mod L in one pass:
+ * out receives n contiguous 32-byte little-endian scalars h mod L. */
+void sha512_mod_l_batch(const uint8_t *buf, const uint64_t *offs, uint64_t n,
+                        uint8_t *out) {
+  for (uint64_t i = 0; i < n; i++) {
+    uint8_t digest[64];
+    sha512_one(buf + offs[i], offs[i + 1] - offs[i], digest);
+    uint64_t x[8];
+    for (int w = 0; w < 8; w++) {
+      uint64_t v = 0;
+      for (int j = 7; j >= 0; j--) v = (v << 8) | digest[8 * w + j];
+      x[w] = v;
+    }
+    mod_l(x, out + 32 * i);
+  }
+}

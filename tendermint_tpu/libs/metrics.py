@@ -1,0 +1,250 @@
+"""Prometheus metrics, per subsystem.
+
+Reference parity: consensus/metrics.go:66, p2p/metrics.go, mempool/metrics.go,
+state/metrics.go — the same metric names under the same `tendermint`
+namespace, so existing reference dashboards work unchanged.  The node wires
+providers when `instrumentation.prometheus` is on (node/node.go:128
+DefaultMetricsProvider); otherwise every subsystem gets the Nop metrics.
+
+Redesign: metrics use a per-node CollectorRegistry (the reference leans on
+the process-global default registry) so multi-node tests and in-proc nets
+don't collide; the /metrics endpoint serves each node's own registry.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+NAMESPACE = "tendermint"
+
+
+class _Nop:
+    """Accepts the whole prometheus surface and does nothing."""
+
+    def labels(self, *a, **k):
+        return self
+
+    def set(self, *a):
+        pass
+
+    def inc(self, *a):
+        pass
+
+    def dec(self, *a):
+        pass
+
+    def observe(self, *a):
+        pass
+
+
+_NOP = _Nop()
+
+
+class _ObservableGauge:
+    """Gauge with an `observe` alias — callers use histogram-style
+    .observe() while the exposed series stays a plain gauge, matching the
+    reference's go-kit Gauge semantics for e.g. block_interval_seconds."""
+
+    def __init__(self, gauge):
+        self._g = gauge
+
+    def observe(self, v) -> None:
+        self._g.set(v)
+
+    def set(self, v) -> None:
+        self._g.set(v)
+
+
+class ConsensusMetrics:
+    """consensus/metrics.go:18."""
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        if registry is None:
+            for name in (
+                "height", "rounds", "validators", "validators_power",
+                "missing_validators", "missing_validators_power",
+                "byzantine_validators", "byzantine_validators_power",
+                "block_interval_seconds", "num_txs", "block_size_bytes",
+                "total_txs", "committed_height", "fast_syncing", "block_parts",
+            ):
+                setattr(self, name, _NOP)
+            return
+        from prometheus_client import Gauge
+
+        sub = "consensus"
+        kw = dict(namespace=NAMESPACE, subsystem=sub, registry=registry,
+                  labelnames=("chain_id",))
+
+        def g(name, doc):
+            return Gauge(name, doc, **kw).labels(chain_id=chain_id)
+
+        self.height = g("height", "Height of the chain.")
+        self.rounds = g("rounds", "Number of rounds.")
+        self.validators = g("validators", "Number of validators.")
+        self.validators_power = g("validators_power", "Total power of all validators.")
+        self.missing_validators = g("missing_validators", "Number of validators who did not sign.")
+        self.missing_validators_power = g(
+            "missing_validators_power", "Total power of the missing validators."
+        )
+        self.byzantine_validators = g(
+            "byzantine_validators", "Number of validators who tried to double sign."
+        )
+        self.byzantine_validators_power = g(
+            "byzantine_validators_power", "Total power of the byzantine validators."
+        )
+        # Gauge in the reference too (consensus/metrics.go:46, v0.33.x);
+        # a python Histogram would also rename the series (_bucket/_count)
+        self.block_interval_seconds = _ObservableGauge(
+            g("block_interval_seconds", "Time between this and the last block.")
+        )
+        self.num_txs = g("num_txs", "Number of transactions.")
+        self.block_size_bytes = g("block_size_bytes", "Size of the block.")
+        self.total_txs = g("total_txs", "Total number of transactions.")
+        self.committed_height = g("latest_block_height", "The latest block height.")
+        self.fast_syncing = g("fast_syncing", "Whether or not a node is fast syncing. 1 if yes, 0 if no.")
+        # counters modeled as Gauges: prometheus_client appends `_total` to
+        # Counter names, which would break the reference's exact series name
+        self.block_parts = Gauge(
+            "block_parts", "Number of blockparts transmitted by peer.",
+            namespace=NAMESPACE, subsystem=sub, registry=registry,
+            labelnames=("chain_id", "peer_id"),
+        )
+
+
+class P2PMetrics:
+    """p2p/metrics.go."""
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        if registry is None:
+            self.peers = _NOP
+            self.peer_receive_bytes_total = _NOP
+            self.peer_send_bytes_total = _NOP
+            return
+        from prometheus_client import Counter, Gauge
+
+        sub = "p2p"
+        self.peers = Gauge(
+            "peers", "Number of peers.", namespace=NAMESPACE, subsystem=sub,
+            registry=registry, labelnames=("chain_id",),
+        ).labels(chain_id=chain_id)
+        self.peer_receive_bytes_total = Counter(
+            "peer_receive_bytes_total", "Number of bytes received from a given peer.",
+            namespace=NAMESPACE, subsystem=sub, registry=registry,
+            labelnames=("chain_id", "peer_id", "chID"),
+        )
+        self.peer_send_bytes_total = Counter(
+            "peer_send_bytes_total", "Number of bytes sent to a given peer.",
+            namespace=NAMESPACE, subsystem=sub, registry=registry,
+            labelnames=("chain_id", "peer_id", "chID"),
+        )
+
+
+class MempoolMetrics:
+    """mempool/metrics.go."""
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        if registry is None:
+            self.size = _NOP
+            self.tx_size_bytes = _NOP
+            self.failed_txs = _NOP
+            self.recheck_times = _NOP
+            return
+        from prometheus_client import Gauge, Histogram
+
+        sub = "mempool"
+        kw = dict(namespace=NAMESPACE, subsystem=sub, registry=registry,
+                  labelnames=("chain_id",))
+        self.size = Gauge("size", "Size of the mempool (number of uncommitted transactions).", **kw).labels(chain_id=chain_id)
+        self.tx_size_bytes = Histogram(
+            "tx_size_bytes", "Transaction sizes in bytes.",
+            namespace=NAMESPACE, subsystem=sub, registry=registry,
+            labelnames=("chain_id",), buckets=[2**i for i in range(4, 21)],
+        ).labels(chain_id=chain_id)
+        # Gauges (not Counters) to keep the reference's exact series names —
+        # prometheus_client appends `_total` to Counter names
+        self.failed_txs = Gauge("failed_txs", "Number of failed transactions.", **kw).labels(chain_id=chain_id)
+        self.recheck_times = Gauge("recheck_times", "Number of times transactions are rechecked in the mempool.", **kw).labels(chain_id=chain_id)
+
+
+class StateMetrics:
+    """state/metrics.go."""
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        if registry is None:
+            self.block_processing_time = _NOP
+            return
+        from prometheus_client import Histogram
+
+        self.block_processing_time = Histogram(
+            "block_processing_time", "Time between BeginBlock and EndBlock in ms.",
+            namespace=NAMESPACE, subsystem="state", registry=registry,
+            labelnames=("chain_id",), buckets=[1 * i for i in range(1, 11)] + [20, 50, 100, 500],
+        ).labels(chain_id=chain_id)
+
+
+class MetricsProvider:
+    """node/node.go:128 DefaultMetricsProvider — one registry per node."""
+
+    def __init__(self, enabled: bool, chain_id: str):
+        self.enabled = enabled
+        self.chain_id = chain_id
+        self.registry = None
+        if enabled:
+            from prometheus_client import CollectorRegistry
+
+            self.registry = CollectorRegistry()
+        self.consensus = ConsensusMetrics(self.registry, chain_id)
+        self.p2p = P2PMetrics(self.registry, chain_id)
+        self.mempool = MempoolMetrics(self.registry, chain_id)
+        self.state = StateMetrics(self.registry, chain_id)
+
+    def exposition(self) -> bytes:
+        if self.registry is None:
+            return b""
+        from prometheus_client import generate_latest
+
+        return generate_latest(self.registry)
+
+
+def nop_provider(chain_id: str = "") -> MetricsProvider:
+    return MetricsProvider(False, chain_id)
+
+
+class MetricsServer:
+    """Standalone /metrics HTTP listener (node/node.go:1121
+    startPrometheusServer flavor), aiohttp-backed."""
+
+    def __init__(self, provider: MetricsProvider, listen_addr: str):
+        self.provider = provider
+        self.listen_addr = listen_addr
+        self._runner = None
+        self.bound_addr: Optional[str] = None
+
+    async def start(self) -> None:
+        from aiohttp import web
+
+        async def metrics(request):
+            return web.Response(
+                body=self.provider.exposition(),
+                content_type="text/plain",
+                charset="utf-8",
+            )
+
+        app = web.Application()
+        app.router.add_get("/metrics", metrics)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        addr = self.listen_addr
+        host, _, port = addr.split("://")[-1].rpartition(":")
+        site = web.TCPSite(runner, host or "127.0.0.1", int(port))
+        await site.start()
+        self._runner = runner
+        for s in runner.sites:
+            srv = getattr(s, "_server", None)
+            if srv and srv.sockets:
+                self.bound_addr = "%s:%d" % srv.sockets[0].getsockname()[:2]
+        self.bound_addr = self.bound_addr or addr
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()

@@ -98,6 +98,9 @@ class Mempool:
         self.pre_check: Optional[Callable[[bytes], Optional[str]]] = None
         self.post_check = None
         self.log = get_logger("mempool")
+        from .libs.metrics import MempoolMetrics
+
+        self.metrics = MempoolMetrics()  # nop; node swaps in prometheus
 
     # -- locking (commit window) ------------------------------------------
     def lock(self):
@@ -153,10 +156,13 @@ class Mempool:
             self._tx_log.append(mtx)
             self._new_tx_event.set()
             self.log.debug("added good transaction", tx=tx_hash(tx).hex()[:16], res=res.code)
+            self.metrics.size.set(len(self.txs))
+            self.metrics.tx_size_bytes.observe(len(tx))
             self._notify_txs_available()
         else:
             if not self.keep_invalid_txs_in_cache:
                 self.cache.remove(tx)
+            self.metrics.failed_txs.inc()
             self.log.debug("rejected bad transaction", tx=tx_hash(tx).hex()[:16], code=res.code)
         return res
 
@@ -220,9 +226,11 @@ class Mempool:
         if self.txs:
             if self.recheck:
                 self.log.debug("recheck txs", num_txs=len(self.txs), height=height)
+                self.metrics.recheck_times.inc()
                 await self._recheck_txs()
             else:
                 self._notify_txs_available()
+        self.metrics.size.set(len(self.txs))
 
     async def _recheck_txs(self) -> None:
         """clist_mempool.go:591 — re-run CheckTx on survivors; drop newly

@@ -96,6 +96,11 @@ class Node(Service):
         self.rpc_server = None
         self.batch_verifier = None
         self.async_verifier = None
+        self.table_cache = None
+        self.addr_book = None
+        self.pex_reactor = None
+        self.metrics_provider = None
+        self.metrics_server = None
 
     async def on_start(self) -> None:
         cfg = self.config
@@ -105,7 +110,7 @@ class Node(Service):
         # path.  This is the BASELINE north-star wiring: the node runs its
         # own engine, not the serial host fallback.
         if cfg.tpu.enabled:
-            from .crypto.batch_verifier import AsyncBatchVerifier, BatchVerifier
+            from .crypto.batch_verifier import AsyncBatchVerifier, BatchVerifier, TableCache
 
             mesh = None
             if cfg.tpu.mesh_devices > 1:
@@ -114,7 +119,12 @@ class Node(Service):
 
                 devs = jax.devices()[: cfg.tpu.mesh_devices]
                 mesh = Mesh(devs, ("batch",))
-            self.batch_verifier = BatchVerifier(mesh=mesh).install()
+            self.batch_verifier = BatchVerifier(
+                mesh=mesh, min_device_batch=cfg.tpu.min_device_batch
+            ).install()
+            # steady-state commit path: per-valset device tables (HBM rows;
+            # tabulated zero-doubling windows on a TPU backend)
+            self.table_cache = TableCache(self.batch_verifier).install()
             self.async_verifier = AsyncBatchVerifier(
                 self.batch_verifier,
                 max_batch=cfg.tpu.max_batch,
@@ -148,12 +158,21 @@ class Node(Service):
             open_db("evidence", home, cfg.base.db_backend), self.state_store
         )
 
+        # metrics provider (node/node.go:128) — per-node registry
+        from .libs.metrics import MetricsProvider
+
+        self.metrics_provider = MetricsProvider(
+            cfg.instrumentation.prometheus, self.genesis_doc.chain_id
+        )
+        self.mempool.metrics = self.metrics_provider.mempool
+
         block_exec = BlockExecutor(
             self.state_store,
             self.proxy_app.consensus(),
             self.mempool,
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
+            metrics=self.metrics_provider.state,
         )
 
         self.consensus = ConsensusState(
@@ -165,6 +184,7 @@ class Node(Service):
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
         )
+        self.consensus.metrics = self.metrics_provider.consensus
         if self.priv_validator is not None:
             self.consensus.set_priv_validator(self.priv_validator)
         cfg.ensure_dirs()
@@ -198,6 +218,7 @@ class Node(Service):
                 max_inbound=cfg.p2p.max_num_inbound_peers,
                 max_outbound=cfg.p2p.max_num_outbound_peers,
             )
+            self.switch.metrics = self.metrics_provider.p2p
             from .fastsync import BlockchainReactor
 
             do_fast_sync = cfg.base.fast_sync and not only_validator_is_us(
@@ -221,7 +242,26 @@ class Node(Service):
                 "MEMPOOL", MempoolReactor(self.mempool, broadcast=cfg.mempool.broadcast)
             )
             self.switch.add_reactor("EVIDENCE", EvidenceReactor(self.evidence_pool))
+            # PEX + address book: peer discovery (node/node.go:381 createPEXReactor)
+            if cfg.p2p.pex:
+                from .p2p.pex import AddrBook, PEXReactor
+
+                book_path = cfg.addr_book_file() if cfg.base.db_backend != "memdb" else ""
+                self.addr_book = AddrBook(
+                    book_path,
+                    strict=cfg.p2p.addr_book_strict,
+                    our_ids={self.node_key.id},
+                )
+                self.switch.addr_book = self.addr_book
+                self.pex_reactor = PEXReactor(
+                    self.addr_book,
+                    seeds=[s for s in cfg.p2p.seeds.split(",") if s],
+                    seed_mode=cfg.p2p.seed_mode,
+                )
+                self.switch.add_reactor("PEX", self.pex_reactor)
             await transport.listen(cfg.p2p.laddr)
+            # advertise the actually-bound address (PEX peers gossip it)
+            node_info.listen_addr = cfg.p2p.external_address or transport.listen_addr
             await self.switch.start()  # starts reactors, incl. consensus
             if cfg.p2p.persistent_peers:
                 await self.switch.dial_peers_async(
@@ -229,6 +269,15 @@ class Node(Service):
                 )
         else:
             await self.consensus.start()
+        # /metrics listener (node/node.go:1121)
+        if cfg.instrumentation.prometheus:
+            from .libs.metrics import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                self.metrics_provider, cfg.instrumentation.prometheus_listen_addr
+            )
+            await self.metrics_server.start()
+            self.log.info("prometheus metrics", laddr=self.metrics_server.bound_addr)
         self.log.info(
             "node started",
             chain_id=self.genesis_doc.chain_id,
@@ -236,6 +285,8 @@ class Node(Service):
         )
 
     async def on_stop(self) -> None:
+        if self.metrics_server is not None:
+            await self.metrics_server.stop()
         if self.switch is not None:
             await self.switch.stop()  # stops reactors incl. consensus
         elif self.consensus is not None:
@@ -256,3 +307,8 @@ class Node(Service):
             # live node may have installed its own engine meanwhile
             if batch_hook.get_verifier() == self.batch_verifier.verify:
                 batch_hook.set_verifier(None)
+            if (
+                self.table_cache is not None
+                and batch_hook.get_indexed_verifier() == self.table_cache.verify_indexed
+            ):
+                batch_hook.set_indexed_verifier(None)

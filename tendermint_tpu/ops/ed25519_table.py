@@ -14,10 +14,17 @@ Verification of signature i then needs NO doublings at all:
 
 i.e. a sum of 128 gathered points, 128 point-adds instead of 384 ladder
 ops — ~2.4x less VPU work for the steady-state commit-verification path
-(BASELINE config #5: 10k-validator commit replay).  The gathers ride XLA
-(HBM-bandwidth, ~420 MB per 10k batch ≈ 1 ms); the adds + inversion +
-canonical compare run in one Pallas kernel with a VMEM accumulator
-(grid = batch tiles × window chunks, k-loop accumulation pattern).
+(BASELINE config #5: 10k-validator commit replay).  The gathers ride XLA;
+the adds + inversion + canonical compare run in one Pallas kernel with a
+VMEM accumulator (grid = batch tiles × window chunks, k-loop pattern).
+
+MEASURED (v5e-1, round 5): 85 ms steady-state per 10k batch vs 31 ms for
+the VMEM-resident Straus ladder (ops/ed25519_pallas.py).  The VPU saving
+is real but the 128 random 160 B row gathers per signature plus the
+[B,128,4,20]→[128,4,20,B] relayout are HBM-bound and dominate.  Kept as
+an opt-in (PubkeyTable(tabulated=True)) with full test coverage; making
+the gather sequential (sorting signatures by validator, fusing the gather
+into the pallas grid) is the open avenue if this path is to win.
 
 Tables store canonical limbs as int16 ([V, 64, 16, 4, 20] = 160 KB per
 validator, 1.6 GB for 10k) and are built on-device in one jitted scan —

@@ -1,0 +1,4 @@
+from .addrbook import AddrBook, KnownAddress
+from .pex_reactor import PEX_CHANNEL, PEXReactor
+
+__all__ = ["AddrBook", "KnownAddress", "PEXReactor", "PEX_CHANNEL"]

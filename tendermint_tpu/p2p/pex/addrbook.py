@@ -1,0 +1,305 @@
+"""Address book: persisted peer-address store with new/old buckets.
+
+Reference parity: p2p/pex/addrbook.go:109 — addresses learned from PEX
+land in "new" buckets (bucketed by source group so one peer can't own the
+table); addresses that held a successful connection are promoted to "old"
+buckets.  Selection is biased between the two tiers, eviction prefers the
+worst address in the fullest bucket, and the whole book persists to JSON
+(p2p/pex/file.go) so a restarting node redials the network it knew.
+
+Asyncio-era redesign: the reference guards the book with a mutex and a
+goroutine saving every 2 min; here the book is single-loop-owned and the
+node saves on a spawned task + on stop.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ...libs.log import get_logger
+from ..transport import parse_peer_addr
+
+NEW_BUCKET_COUNT = 256
+OLD_BUCKET_COUNT = 64
+NEW_BUCKET_SIZE = 64
+OLD_BUCKET_SIZE = 64
+MAX_NEW_BUCKETS_PER_ADDRESS = 4  # addrbook.go maxNewBucketsPerAddress
+GET_SELECTION_PERCENT = 23  # addrbook.go getSelectionPercent
+MAX_GET_SELECTION = 250
+BIAS_TOWARDS_NEW = 30  # % of picks from new buckets once connected a while
+
+
+def _group_key(hostport: str, strict: bool) -> str:
+    """addrbook.go groupKey flavor: /16 for routable IPv4, the literal
+    host otherwise.  Local addresses collapse to one group in non-strict
+    (test) mode so bucketing still spreads by port."""
+    host = hostport.rsplit(":", 1)[0]
+    parts = host.split(".")
+    if len(parts) == 4 and all(p.isdigit() for p in parts):
+        if strict and (parts[0] == "127" or parts[0] == "0"):
+            return "local"
+        return f"{parts[0]}.{parts[1]}"
+    return host
+
+
+@dataclass
+class KnownAddress:
+    """addrbook.go knownAddress."""
+
+    addr: str  # "id@host:port"
+    src: str  # node id that told us
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    bucket_type: str = "new"
+    buckets: List[int] = field(default_factory=list)
+
+    @property
+    def peer_id(self) -> str:
+        return parse_peer_addr(self.addr)[0]
+
+    def is_old(self) -> bool:
+        return self.bucket_type == "old"
+
+    def is_bad(self, now: Optional[float] = None) -> bool:
+        """addrbook.go isBad: too many failed attempts and no recent success."""
+        now = now if now is not None else time.time()
+        if self.last_attempt and now - self.last_attempt < 60:
+            return False  # recently tried: give it a grace period
+        if self.attempts >= 3 and not self.last_success:
+            return True
+        return self.attempts >= 10
+
+    def to_dict(self) -> dict:
+        return {
+            "addr": self.addr,
+            "src": self.src,
+            "attempts": self.attempts,
+            "last_attempt": self.last_attempt,
+            "last_success": self.last_success,
+            "bucket_type": self.bucket_type,
+            "buckets": list(self.buckets),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KnownAddress":
+        return cls(
+            addr=d["addr"],
+            src=d.get("src", ""),
+            attempts=int(d.get("attempts", 0)),
+            last_attempt=float(d.get("last_attempt", 0.0)),
+            last_success=float(d.get("last_success", 0.0)),
+            bucket_type=d.get("bucket_type", "new"),
+            buckets=[int(b) for b in d.get("buckets", [])],
+        )
+
+
+class AddrBook:
+    """p2p/pex/addrbook.go:109."""
+
+    def __init__(self, file_path: str = "", strict: bool = True, our_ids: Optional[set] = None):
+        self.file_path = file_path
+        self.strict = strict
+        self.our_ids = our_ids or set()
+        self.addrs: Dict[str, KnownAddress] = {}  # peer id -> ka
+        self.new_buckets: List[Dict[str, KnownAddress]] = [dict() for _ in range(NEW_BUCKET_COUNT)]
+        self.old_buckets: List[Dict[str, KnownAddress]] = [dict() for _ in range(OLD_BUCKET_COUNT)]
+        self.log = get_logger("addrbook")
+        self._key = os.urandom(8).hex()  # per-book bucket-hash salt
+        if file_path and os.path.exists(file_path):
+            self.load()
+
+    # -- bucketing ---------------------------------------------------------
+
+    def _bucket_idx_new(self, ka: KnownAddress) -> int:
+        data = f"{self._key}:{_group_key(ka.addr.split('@')[-1], self.strict)}:" \
+               f"{_group_key((ka.src or ka.addr).split('@')[-1], self.strict)}"
+        return int.from_bytes(hashlib.sha256(data.encode()).digest()[:4], "big") % NEW_BUCKET_COUNT
+
+    def _bucket_idx_old(self, ka: KnownAddress) -> int:
+        data = f"{self._key}:old:{_group_key(ka.addr.split('@')[-1], self.strict)}"
+        return int.from_bytes(hashlib.sha256(data.encode()).digest()[:4], "big") % OLD_BUCKET_COUNT
+
+    # -- mutation ----------------------------------------------------------
+
+    def add_address(self, addr: str, src: str = "") -> bool:
+        """addrbook.go AddAddress: into a new bucket; False when rejected."""
+        pid, hostport = parse_peer_addr(addr)
+        if not pid or pid in self.our_ids:
+            return False
+        ka = self.addrs.get(pid)
+        if ka is not None:
+            if ka.is_old():
+                return False  # already promoted; don't demote/rebucket
+            if len(ka.buckets) >= MAX_NEW_BUCKETS_PER_ADDRESS:
+                return False
+            ka.src = ka.src or src
+        else:
+            ka = KnownAddress(addr=addr, src=src)
+            self.addrs[pid] = ka
+        idx = self._bucket_idx_new(ka)
+        bucket = self.new_buckets[idx]
+        if pid in bucket:
+            return True
+        if len(bucket) >= NEW_BUCKET_SIZE:
+            self._evict_from_new(idx)
+        bucket[pid] = ka
+        if idx not in ka.buckets:
+            ka.buckets.append(idx)
+        return True
+
+    def _evict_from_new(self, idx: int) -> None:
+        bucket = self.new_buckets[idx]
+        if not bucket:
+            return
+        worst_id = max(
+            bucket, key=lambda p: (bucket[p].is_bad(), bucket[p].attempts, -bucket[p].last_success)
+        )
+        ka = bucket.pop(worst_id)
+        if idx in ka.buckets:
+            ka.buckets.remove(idx)
+        if not ka.buckets:
+            self.addrs.pop(worst_id, None)
+
+    def mark_attempt(self, addr_or_id: str) -> None:
+        ka = self._lookup(addr_or_id)
+        if ka:
+            ka.attempts += 1
+            ka.last_attempt = time.time()
+
+    def mark_good(self, addr_or_id: str) -> None:
+        """addrbook.go MarkGood: promote to an old bucket."""
+        ka = self._lookup(addr_or_id)
+        if ka is None:
+            return
+        ka.attempts = 0
+        ka.last_success = time.time()
+        ka.last_attempt = ka.last_success
+        if ka.is_old():
+            return
+        for idx in ka.buckets:
+            self.new_buckets[idx].pop(ka.peer_id, None)
+        ka.buckets.clear()
+        ka.bucket_type = "old"
+        idx = self._bucket_idx_old(ka)
+        bucket = self.old_buckets[idx]
+        if len(bucket) >= OLD_BUCKET_SIZE:
+            # displace the worst old entry back to new (addrbook.go moveToOld)
+            worst_id = max(bucket, key=lambda p: (bucket[p].attempts, -bucket[p].last_success))
+            demoted = bucket.pop(worst_id)
+            demoted.bucket_type = "new"
+            demoted.buckets.clear()
+            nidx = self._bucket_idx_new(demoted)
+            self.new_buckets[nidx][worst_id] = demoted
+            demoted.buckets.append(nidx)
+        bucket[ka.peer_id] = ka
+        ka.buckets.append(idx)
+
+    def mark_bad(self, addr_or_id: str) -> None:
+        """Remove entirely (addrbook.go MarkBad banishes)."""
+        ka = self._lookup(addr_or_id)
+        if ka is None:
+            return
+        self.remove_address(ka.peer_id)
+
+    def remove_address(self, addr_or_id: str) -> None:
+        ka = self._lookup(addr_or_id)
+        if ka is None:
+            return
+        pid = ka.peer_id
+        for idx in ka.buckets:
+            tier = self.old_buckets if ka.is_old() else self.new_buckets
+            tier[idx].pop(pid, None)
+        self.addrs.pop(pid, None)
+
+    def _lookup(self, addr_or_id: str) -> Optional[KnownAddress]:
+        pid = parse_peer_addr(addr_or_id)[0] if "@" in addr_or_id else addr_or_id
+        return self.addrs.get(pid)
+
+    # -- selection ---------------------------------------------------------
+
+    def size(self) -> int:
+        return len(self.addrs)
+
+    def is_empty(self) -> bool:
+        return not self.addrs
+
+    def need_more_addrs(self) -> bool:
+        return self.size() < 1000  # addrbook.go needAddressThreshold
+
+    def pick_address(self, bias_towards_new: int = BIAS_TOWARDS_NEW) -> Optional[str]:
+        """addrbook.go PickAddress — random non-bad address, tier chosen by
+        bias (% chance of a new-bucket address)."""
+        if self.is_empty():
+            return None
+        candidates_old = [ka for ka in self.addrs.values() if ka.is_old() and not ka.is_bad()]
+        candidates_new = [ka for ka in self.addrs.values() if not ka.is_old() and not ka.is_bad()]
+        use_new = random.randrange(100) < bias_towards_new
+        pool = candidates_new if use_new else candidates_old
+        if not pool:
+            pool = candidates_old or candidates_new
+        if not pool:
+            return None
+        return random.choice(pool).addr
+
+    def get_selection(self) -> List[str]:
+        """addrbook.go GetSelection — random ≤23% (cap 250) for PEX."""
+        if self.is_empty():
+            return []
+        all_addrs = [ka.addr for ka in self.addrs.values()]
+        n = max(min(len(all_addrs), 32), len(all_addrs) * GET_SELECTION_PERCENT // 100)
+        n = min(n, MAX_GET_SELECTION, len(all_addrs))
+        return random.sample(all_addrs, n)
+
+    def has_address(self, addr_or_id: str) -> bool:
+        return self._lookup(addr_or_id) is not None
+
+    # -- persistence (p2p/pex/file.go) -------------------------------------
+
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        os.makedirs(os.path.dirname(self.file_path) or ".", exist_ok=True)
+        payload = {
+            "key": self._key,
+            "addrs": [ka.to_dict() for ka in self.addrs.values()],
+        }
+        tmp = self.file_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.file_path)
+
+    def load(self) -> None:
+        try:
+            with open(self.file_path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            self.log.error("addrbook load failed", err=str(e))
+            return
+        self._key = payload.get("key", self._key)
+        for d in payload.get("addrs", []):
+            try:
+                ka = KnownAddress.from_dict(d)
+            except (KeyError, ValueError):
+                continue
+            pid = ka.peer_id
+            if not pid or pid in self.our_ids:
+                continue
+            self.addrs[pid] = ka
+            ka.buckets.clear()
+            if ka.is_old():
+                idx = self._bucket_idx_old(ka)
+                self.old_buckets[idx][pid] = ka
+                ka.buckets.append(idx)
+            else:
+                idx = self._bucket_idx_new(ka)
+                self.new_buckets[idx][pid] = ka
+                ka.buckets.append(idx)

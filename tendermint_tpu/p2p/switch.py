@@ -42,6 +42,9 @@ class Switch(Service):
         self.addr_book = None
         self._reconnecting: set = set()
         self._connecting: set = set()
+        from ..libs.metrics import P2PMetrics
+
+        self.metrics = P2PMetrics()  # nop; node swaps in prometheus
 
     # -- reactor registry (switch.go:158) ----------------------------------
     def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
@@ -146,6 +149,7 @@ class Switch(Service):
         self.peers[ni.node_id] = peer
         for reactor in self.reactors.values():
             await reactor.add_peer(peer)
+        self.metrics.peers.set(len(self.peers))
         self.log.info("added peer", peer=ni.node_id[:12], outbound=outbound, total=len(self.peers))
         return peer
 
@@ -155,6 +159,9 @@ class Switch(Service):
         if reactor is None:
             await self.stop_peer_for_error(peer, f"unknown channel {chan_id:#x}")
             return
+        self.metrics.peer_receive_bytes_total.labels(
+            chain_id=self.node_info.network, peer_id=peer.id, chID=str(chan_id)
+        ).inc(len(msg))
         await reactor.receive(chan_id, peer, msg)
 
     async def _on_peer_error(self, peer: Peer, err: Exception) -> None:
@@ -174,6 +181,7 @@ class Switch(Service):
 
     async def _stop_and_remove_peer(self, peer: Peer, reason: Optional[str]) -> None:
         self.peers.pop(peer.id, None)
+        self.metrics.peers.set(len(self.peers))
         if peer.is_running:
             await peer.stop()
         for reactor in self.reactors.values():

@@ -109,7 +109,12 @@ class BlockExecutor:
         save state → fire events.  Returns (new_state, retain_height)."""
         self.validate_block(state, block)
 
+        import time as _time
+
+        _t0 = _time.perf_counter()
         abci_responses = await self._exec_block_on_proxy_app(state, block)
+        if self.metrics is not None:
+            self.metrics.block_processing_time.observe((_time.perf_counter() - _t0) * 1000)
         fail_point("applyblock-saved-responses")
         self.state_store.save_abci_responses(block.height, _responses_to_dict(abci_responses))
         fail_point("applyblock-validated-updates")

@@ -51,17 +51,31 @@ def mixed_batch_verify(
     msgs: Sequence[bytes],
     sigs: Sequence[bytes],
     batch_verify: Optional[Callable] = None,
+    indexed: Optional[tuple] = None,
 ) -> List[bool]:
     """Verify a commit's signatures, routing by key type: ed25519 rides the
     installed device batch (crypto/batch.py); other key types (sr25519,
     secp256k1, threshold multisig) verify via their own PubKey.verify — the
     reference's per-key-type dispatch (crypto.PubKey interface), batched
-    where the hardware pays off."""
+    where the hardware pays off.
+
+    `indexed=(set_key, set_pubkey_rows, row_idxs)` lets callers that know
+    the validator-set identity and row indices (verify_commit*) route
+    through the per-valset device table engine (crypto/batch.py indexed
+    hook: HBM pubkey rows / precomputed window tables) — the steady-state
+    path gathers pubkeys on-device instead of shipping them per call."""
     from ..crypto.keys import Ed25519PubKey
 
     n = len(msgs)
     out: List[bool] = [False] * n
     ed_idx = [i for i, pk in enumerate(pubkey_objs) if isinstance(pk, Ed25519PubKey)]
+    if ed_idx and len(ed_idx) == n and indexed is not None and batch_verify is None:
+        iv = crypto_batch.get_indexed_verifier()
+        if iv is not None:
+            set_key, set_rows, row_idxs = indexed
+            res = iv(set_key, set_rows, row_idxs, msgs, sigs)
+            if res is not None:
+                return [bool(r) for r in res]
     if ed_idx:
         verify = batch_verify or crypto_batch.get_verifier()
         res = verify(
@@ -156,9 +170,28 @@ class ValidatorSet:
         self.validators: List[Validator] = []
         self.proposer: Optional[Validator] = None
         self._total_voting_power = 0
+        self._pk_digest: Optional[bytes] = None
         if validators:
             self._update_with_change_set(validators, allow_deletes=False)
             self.increment_proposer_priority(1)
+
+    def pubkeys_digest(self) -> bytes:
+        """Cheap stable key for this set's pubkey rows (device table cache
+        key) — sha256 over the concatenated raw pubkeys, cached until the
+        membership changes.  Unlike hash() this ignores voting power and
+        priorities, which don't affect the pubkey table."""
+        if self._pk_digest is None:
+            import hashlib
+
+            h = hashlib.sha256()
+            for v in self.validators:
+                pk = v.pub_key.bytes()
+                # length-prefix each key: mixed-size key types must not be
+                # able to collide under different concatenation splits
+                h.update(bytes([len(pk) & 0xFF]))
+                h.update(pk)
+            self._pk_digest = h.digest()
+        return self._pk_digest
 
     # -- basic accessors ---------------------------------------------------
     def is_nil_or_empty(self) -> bool:
@@ -217,6 +250,7 @@ class ValidatorSet:
         new.validators = [v.copy() for v in self.validators]
         new.proposer = self.proposer
         new._total_voting_power = self._total_voting_power
+        new._pk_digest = self._pk_digest
         return new
 
     def hash(self) -> bytes:
@@ -329,6 +363,7 @@ class ValidatorSet:
         self._compute_new_priorities(updates, tvp_after_updates_before_removals)
         self._apply_updates(updates)
         self._apply_removals(deletes)
+        self._pk_digest = None  # membership changed: table cache key rotates
         self._update_total_voting_power()
         self.rescale_priorities(PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())
         self._shift_by_avg_proposer_priority()
@@ -440,7 +475,17 @@ class ValidatorSet:
             msgs.append(commit.vote_sign_bytes(chain_id, idx))
             sigs.append(cs.signature)
 
-        ok = mixed_batch_verify(pubkeys, msgs, sigs, batch_verify)
+        indexed = None
+        if crypto_batch.get_indexed_verifier() is not None:
+            # signatures align with set rows: validator index IS the row.
+            # Rows are passed lazily — a table-cache hit (the steady state)
+            # never materializes the V-sized list.
+            indexed = (
+                self.pubkeys_digest(),
+                lambda: [v.pub_key.bytes() for v in self.validators],
+                idxs,
+            )
+        ok = mixed_batch_verify(pubkeys, msgs, sigs, batch_verify, indexed=indexed)
 
         tallied = 0
         needed = self.total_voting_power() * 2 // 3
@@ -517,7 +562,7 @@ class ValidatorSet:
         _verify_commit_basic(commit, height, block_id)
 
         seen_vals = {}
-        idxs, powers, pubkeys, msgs, sigs = [], [], [], [], []
+        idxs, row_idxs, powers, pubkeys, msgs, sigs = [], [], [], [], [], []
         for idx, cs in enumerate(commit.signatures):
             if cs.is_absent():
                 continue
@@ -528,12 +573,20 @@ class ValidatorSet:
                 raise ValueError(f"double vote from {val} ({seen_vals[val_idx]} and {idx})")
             seen_vals[val_idx] = idx
             idxs.append(idx)
+            row_idxs.append(val_idx)
             powers.append(val.voting_power)
             pubkeys.append(val.pub_key)
             msgs.append(commit.vote_sign_bytes(chain_id, idx))
             sigs.append(cs.signature)
 
-        ok = mixed_batch_verify(pubkeys, msgs, sigs, batch_verify)
+        indexed = None
+        if crypto_batch.get_indexed_verifier() is not None:
+            indexed = (
+                self.pubkeys_digest(),
+                lambda: [v.pub_key.bytes() for v in self.validators],
+                row_idxs,
+            )
+        ok = mixed_batch_verify(pubkeys, msgs, sigs, batch_verify, indexed=indexed)
 
         tallied = 0
         needed = self.total_voting_power() * trust_numerator // trust_denominator

@@ -271,6 +271,80 @@ class TestPubkeyTable:
             batch_hook.set_verifier(None)
 
 
+class TestTabulated:
+    """ops/ed25519_table.py: per-validator window tables, zero-doubling
+    verification — differential against the same signatures the ladder
+    kernels verify (pallas interpret mode on CPU)."""
+
+    def test_tabulated_differential(self, verifier):
+        pubkeys, msgs, sigs = make_sigs(5)
+        table = PubkeyTable(pubkeys, verifier, tabulated=True)
+        table._interpret = True
+        idxs = [0, 3, 1, 4, 2, 0]
+        ms = [msgs[i] for i in idxs]
+        ss = [sigs[i] for i in idxs]
+        # corrupt one signature, point one index at the wrong key
+        ss[2] = ss[2][:5] + bytes([ss[2][5] ^ 1]) + ss[2][6:]
+        idxs[4] = 1
+        got = table.verify_indexed(idxs, ms, ss)
+        assert got == [True, True, False, True, False, True]
+
+    def test_table_cache_routes_verify_commit(self, verifier):
+        """verify_commit uses the installed indexed hook (device-resident
+        pubkey rows) and falls back cleanly when the cache declines."""
+        from tendermint_tpu.crypto.batch_verifier import TableCache
+        from tendermint_tpu.types import PRECOMMIT_TYPE, VoteSet
+        from tests.test_types import CHAIN_ID, make_block_id, rand_validator_set, signed_vote
+
+        vset, pvs = rand_validator_set(4)
+        bid = make_block_id()
+        vs = VoteSet(CHAIN_ID, 5, 0, PRECOMMIT_TYPE, vset)
+        for pv in pvs:
+            vs.add_vote(signed_vote(pv, vset, PRECOMMIT_TYPE, 5, 0, bid))
+        commit = vs.make_commit()
+        cache = TableCache(verifier, tabulated=False)
+        calls = {"n": 0}
+        orig = cache.verify_indexed
+
+        def counting(*a):
+            calls["n"] += 1
+            return orig(*a)
+
+        cache.verify_indexed = counting
+        try:
+            batch_hook.set_indexed_verifier(cache.verify_indexed)
+            vset.verify_commit(CHAIN_ID, bid, 5, commit)
+            assert calls["n"] == 1
+            assert vset.pubkeys_digest() in cache._tables
+            # second commit at the same set reuses the cached table
+            vset.verify_commit(CHAIN_ID, bid, 5, commit)
+            assert len(cache._tables) == 1
+        finally:
+            batch_hook.set_indexed_verifier(None)
+
+    def test_bad_sig_still_raises_through_indexed_path(self, verifier):
+        from tendermint_tpu.crypto.batch_verifier import TableCache
+        from tendermint_tpu.types import PRECOMMIT_TYPE, VoteSet
+        from tests.test_types import CHAIN_ID, make_block_id, rand_validator_set, signed_vote
+
+        vset, pvs = rand_validator_set(4)
+        bid = make_block_id()
+        vs = VoteSet(CHAIN_ID, 5, 0, PRECOMMIT_TYPE, vset)
+        for pv in pvs:
+            vs.add_vote(signed_vote(pv, vset, PRECOMMIT_TYPE, 5, 0, bid))
+        commit = vs.make_commit()
+        import dataclasses
+
+        commit.signatures[0] = dataclasses.replace(commit.signatures[0], signature=bytes(64))
+        cache = TableCache(verifier, tabulated=False)
+        try:
+            batch_hook.set_indexed_verifier(cache.verify_indexed)
+            with pytest.raises(ValueError, match="wrong signature"):
+                vset.verify_commit(CHAIN_ID, bid, 5, commit)
+        finally:
+            batch_hook.set_indexed_verifier(None)
+
+
 class TestAsyncBatchVerifier:
     async def test_futures_resolve(self):
         pubkeys, msgs, sigs = make_sigs(4)
@@ -285,6 +359,29 @@ class TestAsyncBatchVerifier:
             assert results == [True, True, True, True, False]
         finally:
             await svc.stop()
+
+
+class TestChunkedIndexed:
+    def test_double_buffered_chunks_match(self, verifier, monkeypatch):
+        """Large indexed batches split into pipelined chunks; results must
+        be identical to the one-shot path, incl. padding + invalid rows."""
+        from tendermint_tpu.crypto import batch_verifier as bv
+
+        monkeypatch.setattr(bv, "_CHUNK", 32)
+        pubkeys, msgs, sigs = make_sigs(12)
+        chunk_verifier = BatchVerifier()
+        chunk_verifier._pallas = False  # XLA kernel: any chunk shape allowed
+        table = PubkeyTable(pubkeys, chunk_verifier)
+        n = 70
+        idxs = [i % 12 for i in range(n)]
+        ms = [msgs[i] for i in idxs]
+        ss = [sigs[i] for i in idxs]
+        ss[40] = ss[40][:3] + bytes([ss[40][3] ^ 1]) + ss[40][4:]  # corrupt
+        idxs[65] = 999  # out-of-range row
+        expect = [True] * n
+        expect[40] = False
+        expect[65] = False
+        assert table.verify_indexed(idxs, ms, ss) == expect
 
 
 class TestWarmup:

@@ -1,0 +1,118 @@
+"""Prometheus metrics tests.
+
+Reference parity: consensus/metrics.go:66, node/node.go:128 — the same
+metric names under the `tendermint` namespace, scraped live from a
+running net.
+"""
+
+import asyncio
+
+from tendermint_tpu.config import test_config as make_test_cfg
+from tendermint_tpu.libs.metrics import MetricsProvider
+from tendermint_tpu.node import Node
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
+
+CHAIN_ID = "metrics-chain"
+
+
+def _gen(pvs):
+    return GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
+    )
+
+
+def _parse(text: str) -> dict:
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, _, value = line.rpartition(" ")
+        out[name] = float(value)
+    return out
+
+
+class TestProvider:
+    def test_nop_provider_accepts_everything(self):
+        p = MetricsProvider(False, CHAIN_ID)
+        p.consensus.height.set(5)
+        p.p2p.peer_receive_bytes_total.labels(chain_id="x", peer_id="y", chID="1").inc(10)
+        p.mempool.tx_size_bytes.observe(100)
+        assert p.exposition() == b""
+
+    def test_prometheus_provider_registers_reference_names(self):
+        p = MetricsProvider(True, CHAIN_ID)
+        p.consensus.height.set(7)
+        p.consensus.validators.set(4)
+        p.mempool.size.set(3)
+        p.p2p.peers.set(2)
+        text = p.exposition().decode()
+        metrics = _parse(text)
+        assert metrics[f'tendermint_consensus_height{{chain_id="{CHAIN_ID}"}}'] == 7
+        assert metrics[f'tendermint_consensus_validators{{chain_id="{CHAIN_ID}"}}'] == 4
+        assert metrics[f'tendermint_mempool_size{{chain_id="{CHAIN_ID}"}}'] == 3
+        assert metrics[f'tendermint_p2p_peers{{chain_id="{CHAIN_ID}"}}'] == 2
+
+    def test_two_providers_do_not_collide(self):
+        # the reference's global default registry would explode here
+        a = MetricsProvider(True, "chain-a")
+        b = MetricsProvider(True, "chain-b")
+        a.consensus.height.set(1)
+        b.consensus.height.set(2)
+        assert b'chain-a' in a.exposition() and b'chain-b' in b.exposition()
+
+
+class TestLiveScrape:
+    async def test_scrape_running_net(self, tmp_path):
+        """Two-validator net, node0 serving /metrics: height advances,
+        peers gauge is live, validators/power populated."""
+        pvs = sorted([MockPV() for _ in range(2)], key=lambda pv: pv.address())
+        gen = _gen(pvs)
+        nodes = []
+        for i, pv in enumerate(pvs):
+            cfg = make_test_cfg(str(tmp_path / f"m{i}"))
+            cfg.rpc.laddr = ""
+            cfg.base.db_backend = "memdb"
+            cfg.p2p.laddr = "127.0.0.1:0"
+            cfg.consensus.skip_timeout_commit = False
+            cfg.consensus.timeout_commit = 0.05
+            if i == 0:
+                cfg.instrumentation.prometheus = True
+                cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+            nodes.append(Node(cfg, gen, priv_validator=pv, db_backend="memdb"))
+        try:
+            for n in nodes:
+                await n.start()
+            addr = f"{nodes[1].node_key.id}@{nodes[1].switch.transport.listen_addr}"
+            await nodes[0].switch.dial_peer(addr)
+
+            async def reach(h):
+                while not all(n.block_store.height() >= h for n in nodes):
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(reach(3), 60.0)
+
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"http://{nodes[0].metrics_server.bound_addr}/metrics") as r:
+                    assert r.status == 200
+                    text = await r.text()
+            metrics = _parse(text)
+            key = f'chain_id="{CHAIN_ID}"'
+            assert metrics[f"tendermint_consensus_height{{{key}}}"] >= 3
+            assert metrics[f"tendermint_consensus_validators{{{key}}}"] == 2
+            assert metrics[f"tendermint_consensus_validators_power{{{key}}}"] == 20
+            assert metrics[f"tendermint_p2p_peers{{{key}}}"] == 1
+            assert f"tendermint_mempool_size{{{key}}}" in metrics
+            # block interval gauge observed a commit (reference: Gauge,
+            # consensus/metrics.go:46 — exact series name preserved)
+            assert metrics[f"tendermint_consensus_block_interval_seconds{{{key}}}"] >= 0
+            # counters keep the reference names (no _total suffix)
+            assert f"tendermint_mempool_failed_txs{{{key}}}" in metrics
+            assert f"tendermint_mempool_recheck_times{{{key}}}" in metrics
+        finally:
+            for n in nodes:
+                if n.is_running:
+                    await n.stop()
