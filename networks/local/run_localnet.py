@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Run a generated testnet as real OS processes (no docker needed).
+
+Usage:
+    python -m tendermint_tpu.cli testnet --validators 4 --output ./build
+    python networks/local/run_localnet.py ./build [--duration 30]
+
+Spawns one `tendermint_tpu node` process per node directory, polls every
+node's RPC for height, prints progress, and tears everything down on
+Ctrl-C or after --duration seconds.  Exit code 0 iff every node committed
+at least 3 blocks and all heads agree within 2 heights.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+
+def rpc(port: int, path: str):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/{path}", timeout=2) as r:
+        return json.load(r)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("build_dir")
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--base-port", type=int, default=26656)
+    args = ap.parse_args()
+
+    homes = sorted(
+        os.path.join(args.build_dir, d)
+        for d in os.listdir(args.build_dir)
+        if d.startswith("node")
+    )
+    if not homes:
+        print(f"no node*/ directories under {args.build_dir}", file=sys.stderr)
+        return 2
+    rpc_ports = [args.base_port + 10 * i + 1 for i in range(len(homes))]
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cli", "--home", home, "node"],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        for home in homes
+    ]
+    print(f"spawned {len(procs)} nodes; polling for {args.duration:.0f}s")
+    ok = False
+    try:
+        deadline = time.time() + args.duration
+        while time.time() < deadline:
+            time.sleep(2)
+            heights = []
+            for port in rpc_ports:
+                try:
+                    heights.append(
+                        int(rpc(port, "status")["result"]["sync_info"]["latest_block_height"])
+                    )
+                except Exception:
+                    heights.append(-1)
+            print("heights:", heights)
+            if min(heights) >= 3 and max(heights) - min(heights) <= 2:
+                print("localnet healthy: all nodes committing in lock-step")
+                ok = True
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,15 +115,24 @@ def cmd_testnet(args) -> int:
         validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
     )
     base_port = args.base_port
+    docker = getattr(args, "populate_docker_addresses", False)
     for i, home in enumerate(homes):
         cfg = Config(home=home)
         cfg.base.chain_id = chain_id
         cfg.base.moniker = f"node{i}"
-        cfg.p2p.laddr = f"tcp://127.0.0.1:{base_port + 10 * i}"
-        cfg.rpc.laddr = f"tcp://127.0.0.1:{base_port + 10 * i + 1}"
-        cfg.p2p.persistent_peers = ",".join(
-            f"{node_keys[j].id}@127.0.0.1:{base_port + 10 * j}" for j in range(n) if j != i
-        )
+        if docker:
+            # networks/local topology: fixed container IPs, standard ports
+            cfg.p2p.laddr = "tcp://0.0.0.0:26656"
+            cfg.rpc.laddr = "tcp://0.0.0.0:26657"
+            cfg.p2p.persistent_peers = ",".join(
+                f"{node_keys[j].id}@192.167.10.{2 + j}:26656" for j in range(n) if j != i
+            )
+        else:
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{base_port + 10 * i}"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{base_port + 10 * i + 1}"
+            cfg.p2p.persistent_peers = ",".join(
+                f"{node_keys[j].id}@127.0.0.1:{base_port + 10 * j}" for j in range(n) if j != i
+            )
         cfg.p2p.allow_duplicate_ip = True
         _write_cfg(cfg)
         genesis.save_as(cfg.genesis_file())
@@ -234,6 +243,52 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_debug_dump(args) -> int:
+    """commands/debug/dump.go — bundle status + net_info +
+    dump_consensus_state + task dump from a running node's RPC into a
+    timestamped directory (one per --interval tick)."""
+    from .rpc.client import HTTPClient
+
+    async def one_dump(idx: int) -> None:
+        out_dir = os.path.join(args.output, f"dump_{idx}_{int(time.time())}")
+        os.makedirs(out_dir, exist_ok=True)
+        async with HTTPClient(args.rpc_laddr) as c:
+            for name, method, params in (
+                ("status", "status", {}),
+                ("net_info", "net_info", {}),
+                ("consensus_state", "dump_consensus_state", {}),
+                ("tasks", "unsafe_dump_tasks", {}),
+            ):
+                try:
+                    res = await c._call(method, params)
+                except Exception as e:  # unsafe routes may be gated off
+                    res = {"error": str(e)}
+                with open(os.path.join(out_dir, f"{name}.json"), "w") as f:
+                    json.dump(res, f, indent=1, default=repr)
+        print(f"wrote {out_dir}")
+
+    async def main():
+        # interval > 0 with no explicit --count loops until interrupted
+        # (the reference `debug dump` behaves the same); otherwise one
+        # dump per count.
+        forever = args.interval > 0 and args.count <= 0
+        i = 0
+        while forever or i < max(args.count, 1):
+            await one_dump(i)
+            i += 1
+            more = forever or i < args.count
+            if args.interval > 0 and more:
+                await asyncio.sleep(args.interval)
+            elif not more:
+                break
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 # -- parser -----------------------------------------------------------------
 
 
@@ -257,6 +312,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", "-o", default="./mytestnet")
     sp.add_argument("--chain-id", default="")
     sp.add_argument("--base-port", type=int, default=26656)
+    sp.add_argument(
+        "--populate-docker-addresses",
+        action="store_true",
+        help="wire peers for the docker-compose localnet (192.167.10.x)",
+    )
     sp.set_defaults(fn=cmd_testnet)
 
     sp = sub.add_parser("gen_validator", help="generate a validator keypair")
@@ -287,6 +347,22 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hash", required=True, help="trusted header hash (hex)")
     sp.add_argument("--trusting-period", type=float, default=168 * 3600)
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("debug", help="capture a debug bundle from a running node")
+    dsub = sp.add_subparsers(dest="debug_cmd", required=True)
+    dp = dsub.add_parser("dump", help="write status/net_info/consensus-state/task bundle")
+    dp.add_argument("--rpc-laddr", default="127.0.0.1:26657")
+    dp.add_argument("--output", default="debug_dump")
+    dp.add_argument(
+        "--interval", type=float, default=0.0, help="seconds between dumps (0 = one dump)"
+    )
+    dp.add_argument(
+        "--count",
+        type=int,
+        default=0,
+        help="number of dumps; 0 with --interval > 0 = until interrupted",
+    )
+    dp.set_defaults(fn=cmd_debug_dump)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

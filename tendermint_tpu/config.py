@@ -70,6 +70,8 @@ class P2PConfig:
     handshake_timeout: float = 20.0
     dial_timeout: float = 3.0
     test_fuzz: bool = False
+    test_fuzz_prob_drop: float = 0.02
+    test_fuzz_max_delay: float = 0.01
 
 
 @dataclass
@@ -198,6 +200,9 @@ class Config:
     def addr_book_file(self) -> str:
         return self._join(self.p2p.addr_book_file)
 
+    def mempool_wal_dir(self) -> str:
+        return self._join(self.mempool.wal_dir)
+
     def db_dir(self) -> str:
         return self._join("data")
 
@@ -247,6 +252,8 @@ def test_config(home: str) -> Config:
     )
     cfg.base.fast_sync = False
     cfg.p2p.laddr = ""  # tests opt into p2p with an explicit 127.0.0.1:0
+    # test nets share 127.0.0.1 (config.go TestP2PConfig AllowDuplicateIP)
+    cfg.p2p.allow_duplicate_ip = True
     # host verify is faster than XLA compiles at test scale; engine tests
     # turn the device path back on explicitly
     cfg.tpu.enabled = False

@@ -37,7 +37,7 @@ async def run_replay_file(config, console: bool = False, input_fn=input) -> int:
 
     event_bus = EventBus()
     await event_bus.start()
-    proxy_app = AppConns(default_client_creator(config.base.proxy_app))
+    proxy_app = AppConns(default_client_creator(config.base.proxy_app, config.base.abci))
     await proxy_app.start()
     try:
         handshaker = Handshaker(state_store, state, block_store, genesis_doc)

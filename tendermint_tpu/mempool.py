@@ -101,6 +101,31 @@ class Mempool:
         from .libs.metrics import MempoolMetrics
 
         self.metrics = MempoolMetrics()  # nop; node swaps in prometheus
+        self._wal = None  # optional tx journal (clist_mempool.go InitWAL)
+
+    # -- WAL (clist_mempool.go:137) ----------------------------------------
+    def init_wal(self, wal_dir: str) -> None:
+        """Append every accepted tx to `<wal_dir>/wal` — an operator-grade
+        journal of what entered the mempool (the reference writes the raw
+        tx + newline; here length-prefixed hex lines so binary txs with
+        newlines survive a round-trip)."""
+        import os
+
+        os.makedirs(wal_dir, exist_ok=True)
+        self._wal = open(os.path.join(wal_dir, "wal"), "ab")
+
+    def close_wal(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+
+    def _wal_write(self, tx: bytes) -> None:
+        if self._wal is not None:
+            try:
+                self._wal.write(tx.hex().encode() + b"\n")
+                self._wal.flush()
+            except OSError as e:
+                self.log.error("mempool wal write failed", err=str(e))
 
     # -- locking (commit window) ------------------------------------------
     def lock(self):
@@ -155,6 +180,7 @@ class Mempool:
             self.txs_bytes += len(tx)
             self._tx_log.append(mtx)
             self._new_tx_event.set()
+            self._wal_write(tx)
             self.log.debug("added good transaction", tx=tx_hash(tx).hex()[:16], res=res.code)
             self.metrics.size.set(len(self.txs))
             self.metrics.tx_size_bytes.observe(len(tx))

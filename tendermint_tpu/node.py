@@ -76,7 +76,9 @@ class Node(Service):
         self.state_store = StateStore(self.state_db)
 
         self.event_bus = EventBus()
-        creator = client_creator or default_client_creator(config.base.proxy_app)
+        creator = client_creator or default_client_creator(
+            config.base.proxy_app, config.base.abci
+        )
         self.proxy_app = AppConns(creator)
 
         self.state = self.state_store.load_from_db_or_genesis(genesis_doc)
@@ -101,6 +103,7 @@ class Node(Service):
         self.pex_reactor = None
         self.metrics_provider = None
         self.metrics_server = None
+        self.grpc_server = None
 
     async def on_start(self) -> None:
         cfg = self.config
@@ -147,6 +150,8 @@ class Node(Service):
         self.mempool = Mempool(
             self.proxy_app.mempool(), cfg.mempool.as_dict(), height=self.state.last_block_height
         )
+        if cfg.mempool.wal_dir and cfg.base.db_backend != "memdb":
+            self.mempool.init_wal(cfg.mempool_wal_dir())
         if cfg.consensus.wait_for_txs():
             self.mempool.enable_txs_available()
 
@@ -198,6 +203,11 @@ class Node(Service):
             self.rpc_server = RPCServer(self, cfg.rpc)
             await self.rpc_server.start()
             self.log.info("rpc listening", laddr=cfg.rpc.laddr)
+        if cfg.rpc.grpc_laddr:
+            from .rpc.grpc_api import BroadcastAPIServer
+
+            self.grpc_server = BroadcastAPIServer(self, cfg.rpc.grpc_laddr)
+            await self.grpc_server.start()
 
         # p2p stack + reactors (node/node.go:653-709)
         if cfg.p2p.laddr and cfg.p2p.laddr != "none":
@@ -213,12 +223,41 @@ class Node(Service):
                 moniker=cfg.base.moniker,
             )
             transport = Transport(self.node_key, node_info)
+            fuzz_config = None
+            if cfg.p2p.test_fuzz:  # p2p/fuzz.go — soak-test chaos wrapper
+                fuzz_config = {
+                    "prob_drop_rw": cfg.p2p.test_fuzz_prob_drop,
+                    "max_delay": cfg.p2p.test_fuzz_max_delay,
+                }
             self.switch = Switch(
                 transport,
                 max_inbound=cfg.p2p.max_num_inbound_peers,
                 max_outbound=cfg.p2p.max_num_outbound_peers,
+                fuzz_config=fuzz_config,
+                unconditional_peer_ids={
+                    s for s in cfg.p2p.unconditional_peer_ids.split(",") if s
+                },
+                allow_duplicate_ip=cfg.p2p.allow_duplicate_ip,
             )
             self.switch.metrics = self.metrics_provider.p2p
+            if cfg.base.filter_peers:
+                # ABCI peer filter (node/node.go:498): the app may veto a
+                # peer via Query at p2p/filter/id/<id>
+                query_conn = self.proxy_app.query()
+
+                async def abci_filter(ni, conn):
+                    # bounded: a hung app query must not stall the accept
+                    # loop (the reference uses a 5s filter timeout); a
+                    # timeout raises and the switch rejects (fail closed)
+                    res = await asyncio.wait_for(
+                        query_conn.query(
+                            abci_types.RequestQuery(path=f"/p2p/filter/id/{ni.node_id}")
+                        ),
+                        5.0,
+                    )
+                    return None if res.code == 0 else f"abci filter code {res.code}"
+
+                self.switch.peer_filters.append(abci_filter)
             from .fastsync import BlockchainReactor
 
             do_fast_sync = cfg.base.fast_sync and not only_validator_is_us(
@@ -251,6 +290,7 @@ class Node(Service):
                     book_path,
                     strict=cfg.p2p.addr_book_strict,
                     our_ids={self.node_key.id},
+                    private_ids={s for s in cfg.p2p.private_peer_ids.split(",") if s},
                 )
                 self.switch.addr_book = self.addr_book
                 self.pex_reactor = PEXReactor(
@@ -293,9 +333,13 @@ class Node(Service):
             await self.consensus.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
+        if self.grpc_server is not None:
+            await self.grpc_server.stop()
         await self.indexer_service.stop()
         await self.event_bus.stop()
         await self.proxy_app.stop()
+        if self.mempool is not None:
+            self.mempool.close_wal()
         if isinstance(self.priv_validator, Service) and self.priv_validator.is_running:
             await self.priv_validator.stop()
         if self.async_verifier is not None:

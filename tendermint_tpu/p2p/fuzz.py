@@ -1,0 +1,63 @@
+"""Fuzz layer: probabilistic message loss + latency injection.
+
+Reference parity: p2p/fuzz.go:14 FuzzedConnection (ProbDropRW / MaxDelay)
+— config-gated chaos for soak tests.
+
+Redesign: the reference wraps the raw net.Conn; under our SecretConnection
+a byte-level drop desyncs the AEAD stream, and under MConnection a
+packet-level drop corrupts multi-packet message reassembly — both turn
+"loss" into instant connection death, which tests reconnect but not
+protocol liveness under loss.  Here the fuzz sits at the CHANNEL MESSAGE
+boundary: whole gossip messages are dropped or delayed, framing stays
+intact, and the consensus/mempool/evidence reactors must survive real
+message loss by retransmission — the property the soak is after.
+(Connection churn itself is covered separately: dropped-link reconnect is
+exercised by the crash/recovery suite.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Optional
+
+from ..libs.log import get_logger
+
+
+class PeerFuzz:
+    """Per-peer message-level chaos: installed by the switch when
+    p2p.test_fuzz is on.  Wraps peer.send and filters inbound messages."""
+
+    def __init__(self, prob_drop_rw: float = 0.02, max_delay: float = 0.01,
+                 seed: Optional[int] = None):
+        self.prob_drop_rw = prob_drop_rw
+        self.max_delay = max_delay
+        self.rng = random.Random(seed)
+        self.dropped_sends = 0
+        self.dropped_recvs = 0
+        self.log = get_logger("fuzz")
+
+    async def _maybe_delay(self) -> None:
+        if self.max_delay > 0:
+            await asyncio.sleep(self.rng.random() * self.max_delay)
+
+    def install(self, peer) -> "PeerFuzz":
+        orig_send = peer.send
+
+        async def fuzzed_send(chan_id: int, msg: bytes) -> bool:
+            await self._maybe_delay()
+            if self.rng.random() < self.prob_drop_rw:
+                self.dropped_sends += 1
+                return True  # swallowed: lost on the wire
+            return await orig_send(chan_id, msg)
+
+        peer.send = fuzzed_send
+        peer.fuzz = self
+        return self
+
+    def drop_recv(self) -> bool:
+        """True when an inbound message should be dropped."""
+        if self.rng.random() < self.prob_drop_rw:
+            self.dropped_recvs += 1
+            return True
+        return False

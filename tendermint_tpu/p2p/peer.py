@@ -32,6 +32,7 @@ class Peer(Service):
         self.outbound = outbound
         self.persistent = persistent
         self.socket_addr = socket_addr
+        self.remote_ip = getattr(conn, "remote_ip", "")
         self.log = get_logger(f"peer:{node_info.node_id[:8]}")
         self._data: Dict[str, object] = {}  # reactor scratch (peer.Set/Get)
 

@@ -103,10 +103,19 @@ class KnownAddress:
 class AddrBook:
     """p2p/pex/addrbook.go:109."""
 
-    def __init__(self, file_path: str = "", strict: bool = True, our_ids: Optional[set] = None):
+    def __init__(
+        self,
+        file_path: str = "",
+        strict: bool = True,
+        our_ids: Optional[set] = None,
+        private_ids: Optional[set] = None,
+    ):
         self.file_path = file_path
         self.strict = strict
         self.our_ids = our_ids or set()
+        # private peers may be known and dialed but are NEVER gossiped
+        # (pex_reactor.go AddPrivateIDs)
+        self.private_ids = private_ids or set()
         self.addrs: Dict[str, KnownAddress] = {}  # peer id -> ka
         self.new_buckets: List[Dict[str, KnownAddress]] = [dict() for _ in range(NEW_BUCKET_COUNT)]
         self.old_buckets: List[Dict[str, KnownAddress]] = [dict() for _ in range(OLD_BUCKET_COUNT)]
@@ -252,7 +261,11 @@ class AddrBook:
         """addrbook.go GetSelection — random ≤23% (cap 250) for PEX."""
         if self.is_empty():
             return []
-        all_addrs = [ka.addr for ka in self.addrs.values()]
+        all_addrs = [
+            ka.addr for pid, ka in self.addrs.items() if pid not in self.private_ids
+        ]
+        if not all_addrs:
+            return []
         n = max(min(len(all_addrs), 32), len(all_addrs) * GET_SELECTION_PERCENT // 100)
         n = min(n, MAX_GET_SELECTION, len(all_addrs))
         return random.sample(all_addrs, n)

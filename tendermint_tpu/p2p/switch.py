@@ -28,9 +28,25 @@ class SwitchError(Exception):
 
 
 class Switch(Service):
-    def __init__(self, transport: Transport, max_inbound: int = 40, max_outbound: int = 10):
+    def __init__(
+        self,
+        transport: Transport,
+        max_inbound: int = 40,
+        max_outbound: int = 10,
+        fuzz_config: Optional[dict] = None,
+        unconditional_peer_ids: Optional[set] = None,
+        allow_duplicate_ip: bool = True,  # node passes config (default false)
+    ):
         super().__init__("p2p-switch")
         self.transport = transport
+        self.fuzz_config = fuzz_config  # p2p/fuzz.go: chaos wrapper, tests only
+        # switch.go:69 policies: unconditional peers bypass the caps;
+        # dup-IP inbound is rejected unless allowed (transport.go:376)
+        self.unconditional_peer_ids = unconditional_peer_ids or set()
+        self.allow_duplicate_ip = allow_duplicate_ip
+        # filter callbacks: fn(node_info, conn) raises/returns reason str to
+        # reject (the reference's ABCI peer filters, node.go:498)
+        self.peer_filters: List = []
         self.reactors: Dict[str, Reactor] = {}
         self.reactors_by_ch: Dict[int, Reactor] = {}
         self.channel_descs: List[ChannelDescriptor] = []
@@ -87,12 +103,23 @@ class Switch(Service):
     async def _accept_routine(self) -> None:
         while True:
             conn, ni = await self.transport.accept()
+            unconditional = ni.node_id in self.unconditional_peer_ids
             n_inbound = sum(1 for p in self.peers.values() if not p.outbound)
-            if n_inbound >= self.max_inbound:
+            if n_inbound >= self.max_inbound and not unconditional:
                 self.log.info("rejecting inbound: full", peer=ni.node_id[:12])
                 conn.close()
                 continue
-            await self._add_peer_conn(conn, ni, outbound=False)
+            if not self.allow_duplicate_ip and not unconditional:
+                ip = getattr(conn, "remote_ip", "")
+                if ip and any(p.remote_ip == ip for p in self.peers.values()):
+                    self.log.info("rejecting inbound: duplicate IP", ip=ip)
+                    conn.close()
+                    continue
+            # admit concurrently: peer filters may await (ABCI query, up to
+            # 5s each) and must not serialize the accept loop
+            self.spawn(
+                self._add_peer_conn(conn, ni, outbound=False), f"admit-{ni.node_id[:8]}"
+            )
 
     # -- outbound ----------------------------------------------------------
     async def dial_peer(self, addr: str, persistent: bool = False) -> Optional[Peer]:
@@ -133,6 +160,19 @@ class Switch(Service):
     async def _add_peer_conn_locked(
         self, conn, ni: NodeInfo, outbound: bool, persistent: bool, addr: str
     ) -> Optional[Peer]:
+        for filt in self.peer_filters:
+            try:
+                reason = filt(ni, conn)
+                if asyncio.iscoroutine(reason):
+                    reason = await reason
+            except Exception as e:
+                # fail CLOSED: a broken/slow filter must reject, not admit
+                # (str(e) can be empty — repr never is)
+                reason = repr(e)
+            if reason:
+                self.log.info("peer filtered", peer=ni.node_id[:12], reason=reason)
+                conn.close()
+                return None
         peer = Peer(
             conn,
             ni,
@@ -143,6 +183,10 @@ class Switch(Service):
             persistent=persistent or ni.node_id in self.persistent_addrs,
             socket_addr=addr,
         )
+        if self.fuzz_config is not None:
+            from .fuzz import PeerFuzz
+
+            PeerFuzz(**self.fuzz_config).install(peer)
         for reactor in self.reactors.values():
             await reactor.init_peer(peer)
         await peer.start()
@@ -162,6 +206,9 @@ class Switch(Service):
         self.metrics.peer_receive_bytes_total.labels(
             chain_id=self.node_info.network, peer_id=peer.id, chID=str(chan_id)
         ).inc(len(msg))
+        fuzz = getattr(peer, "fuzz", None)
+        if fuzz is not None and fuzz.drop_recv():
+            return  # chaos: inbound message lost
         await reactor.receive(chan_id, peer, msg)
 
     async def _on_peer_error(self, peer: Peer, err: Exception) -> None:

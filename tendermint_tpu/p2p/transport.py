@@ -81,6 +81,9 @@ class Transport:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> Tuple[SecretConnection, NodeInfo]:
         conn = await SecretConnection.make(reader, writer, self.node_key.priv_key)
+        peername = writer.get_extra_info("peername")
+        # remote socket IP, for the switch's dup-IP filter (transport.go:376)
+        conn.remote_ip = peername[0] if peername else ""
 
         # node-info handshake (transport.go:504): exchange concurrently
         await conn.write_msg(msgpack.packb(self.node_info.to_dict(), use_bin_type=True))

@@ -26,13 +26,18 @@ def local_client_creator(app: Application) -> ClientCreator:
     return lambda: LocalClient(app, lock)
 
 
-def remote_client_creator(address: str) -> ClientCreator:
+def remote_client_creator(address: str, transport: str = "socket") -> ClientCreator:
+    if transport == "grpc":
+        from .abci.grpc import GRPCClient
+
+        return lambda: GRPCClient(address)
     return lambda: SocketClient(address)
 
 
-def default_client_creator(address: str) -> ClientCreator:
+def default_client_creator(address: str, transport: str = "socket") -> ClientCreator:
     """proxy/client.go DefaultClientCreator: builtin names get in-proc
-    apps, anything else is a socket address."""
+    apps, anything else is a socket (or, per config `abci = "grpc"`,
+    gRPC) address."""
     if address == "kvstore":
         return local_client_creator(KVStoreApplication())
     if address == "counter":
@@ -41,7 +46,7 @@ def default_client_creator(address: str) -> ClientCreator:
         return local_client_creator(CounterApplication(serial=True))
     if address == "noop":
         return local_client_creator(BaseApplication())
-    return remote_client_creator(address)
+    return remote_client_creator(address, transport)
 
 
 class AppConns(Service):

@@ -65,8 +65,19 @@ class RPCCore:
         # unsafe (gated by cfg.rpc.unsafe; routes.go:48-56)
         "dial_peers",
         "unsafe_flush_mempool",
+        "unsafe_start_cpu_profiler",
+        "unsafe_stop_cpu_profiler",
+        "unsafe_write_heap_profile",
+        "unsafe_dump_tasks",
     )
-    UNSAFE = {"dial_peers", "unsafe_flush_mempool"}
+    UNSAFE = {
+        "dial_peers",
+        "unsafe_flush_mempool",
+        "unsafe_start_cpu_profiler",
+        "unsafe_stop_cpu_profiler",
+        "unsafe_write_heap_profile",
+        "unsafe_dump_tasks",
+    }
 
     def __init__(self, node, unsafe: bool = False, timeout_broadcast_tx_commit: float = 10.0):
         self.node = node
@@ -507,6 +518,56 @@ class RPCCore:
     async def unsafe_flush_mempool(self) -> dict:
         await self.node.mempool.flush()
         return {}
+
+    # -- profiling/debug routes (routes.go:48-56; cProfile stands in for
+    # pprof, an asyncio task dump for the goroutine dump) ------------------
+
+    async def unsafe_start_cpu_profiler(self, filename: str = "cpu.prof") -> dict:
+        import cProfile
+
+        if getattr(self, "_profiler", None) is not None:
+            raise RPCError(INTERNAL_ERROR, "cpu profiler already running")
+        self._profiler = cProfile.Profile()
+        self._profiler_file = filename
+        self._profiler.enable()
+        return {}
+
+    async def unsafe_stop_cpu_profiler(self) -> dict:
+        prof = getattr(self, "_profiler", None)
+        if prof is None:
+            raise RPCError(INTERNAL_ERROR, "cpu profiler not running")
+        prof.disable()
+        prof.dump_stats(self._profiler_file)
+        self._profiler = None
+        return {"filename": self._profiler_file}
+
+    async def unsafe_write_heap_profile(self, filename: str = "heap.prof") -> dict:
+        import tracemalloc
+
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+            return {"log": "tracemalloc started; call again for a snapshot"}
+        snap = tracemalloc.take_snapshot()
+        lines = [str(stat) for stat in snap.statistics("lineno")[:200]]
+        with open(filename, "w") as f:
+            f.write("\n".join(lines))
+        return {"filename": filename, "entries": len(lines)}
+
+    async def unsafe_dump_tasks(self) -> dict:
+        """Our goroutine dump: every live asyncio task with its stack."""
+        import io
+        import traceback
+
+        tasks = []
+        for task in asyncio.all_tasks():
+            buf = io.StringIO()
+            task.print_stack(limit=8, file=buf)
+            tasks.append({
+                "name": task.get_name(),
+                "done": task.done(),
+                "stack": buf.getvalue(),
+            })
+        return {"n_tasks": len(tasks), "tasks": tasks}
 
 
 def now_ns() -> int:

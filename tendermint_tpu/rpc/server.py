@@ -79,6 +79,7 @@ class RPCServer(Service):
         app = web.Application(client_max_size=self.cfg.max_body_bytes)
         app.router.add_post("/", self._handle_post)
         app.router.add_get("/websocket", self._handle_ws)
+        app.router.add_get("/openapi.json", self._handle_openapi)
         app.router.add_get("/{method}", self._handle_get)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
@@ -137,6 +138,13 @@ class RPCServer(Service):
             return make_response(req_id, error=e)
 
     # -- HTTP GET: URI params ---------------------------------------------
+
+    async def _handle_openapi(self, request: web.Request) -> web.Response:
+        """rpc/swagger flavor — spec generated from the route table."""
+        from ..version import VERSION
+        from .openapi import generate_spec
+
+        return web.json_response(generate_spec(VERSION))
 
     async def _handle_get(self, request: web.Request) -> web.Response:
         method = request.match_info["method"]

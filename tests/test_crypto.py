@@ -132,3 +132,70 @@ def test_merkle_known_structure():
     la = hashlib.sha256(b"\x00a").digest()
     lb = hashlib.sha256(b"\x00b").digest()
     assert hash_from_byte_slices([b"a", b"b"]) == hashlib.sha256(b"\x01" + la + lb).digest()
+
+
+class TestXChaCha20Poly1305:
+    """crypto/xchacha20poly1305/vector_test.go — draft-irtf-cfrg-xchacha-03
+    vectors."""
+
+    def test_hchacha20_vector(self):
+        from tendermint_tpu.crypto.xchacha20poly1305 import hchacha20
+
+        key = bytes(range(32))
+        nonce16 = bytes.fromhex("000000090000004a0000000031415927")
+        assert hchacha20(key, nonce16).hex() == (
+            "82413b4227b27bfed30e42508a877d73"
+            "a0f9e4d58a74a853c12ec41326d3ecdc"
+        )
+
+    def test_aead_vector_and_roundtrip(self):
+        from tendermint_tpu.crypto.xchacha20poly1305 import XChaCha20Poly1305
+
+        pt = (
+            b"Ladies and Gentlemen of the class of '99: If I could offer you "
+            b"only one tip for the future, sunscreen would be it."
+        )
+        aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+        key = bytes.fromhex(
+            "808182838485868788898a8b8c8d8e8f909192939495969798999a9b9c9d9e9f"
+        )
+        nonce = bytes.fromhex("404142434445464748494a4b4c4d4e4f5051525354555657")
+        aead = XChaCha20Poly1305(key)
+        ct = aead.seal(nonce, pt, aad)
+        assert ct[:16].hex() == "bd6d179d3e83d43b9576579493c0e939"
+        assert ct[-16:].hex() == "c0875924c1c7987947deafd8780acf49"
+        assert aead.open(nonce, ct, aad) == pt
+        # tamper detection
+        bad = ct[:-1] + bytes([ct[-1] ^ 1])
+        import pytest as _pytest
+
+        with _pytest.raises(Exception):
+            aead.open(nonce, bad, aad)
+
+
+class TestArmor:
+    """crypto/armor/armor_test.go."""
+
+    def test_roundtrip_with_headers(self):
+        from tendermint_tpu.crypto.armor import decode_armor, encode_armor
+
+        data = os.urandom(200)
+        s = encode_armor("TENDERMINT PRIVATE KEY", {"kdf": "bcrypt", "salt": "abcd"}, data)
+        bt, headers, out = decode_armor(s)
+        assert bt == "TENDERMINT PRIVATE KEY"
+        assert headers == {"kdf": "bcrypt", "salt": "abcd"}
+        assert out == data
+
+    def test_corrupt_checksum_rejected(self):
+        from tendermint_tpu.crypto.armor import decode_armor, encode_armor
+
+        s = encode_armor("TEST BLOCK", {}, b"payload-bytes")
+        lines = s.splitlines()
+        # flip a base64 body char
+        body_idx = next(i for i, ln in enumerate(lines) if ln and not ln.startswith("-") and ":" not in ln and not ln.startswith("="))
+        ln = lines[body_idx]
+        lines[body_idx] = ("B" if ln[0] != "B" else "C") + ln[1:]
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            decode_armor("\n".join(lines))
