@@ -100,14 +100,17 @@ def _camel(snake: str) -> str:
 class GRPCClient(Service):
     """abci/client/grpc_client.go:34 — the node-side ABCI client over gRPC.
 
-    Same interface as SocketClient/LocalClient; per-connection ordering is
-    preserved by serializing calls on one channel."""
+    Same interface as SocketClient/LocalClient.  Calls are serialized with
+    a lock: concurrent unary calls would ride independent HTTP/2 streams
+    and could reach the app out of issue order, breaking order-sensitive
+    apps that the socket transport's FIFO framing supports."""
 
     def __init__(self, address: str):
         super().__init__("abci-grpc-client")
         self.address = address.split("://")[-1]
         self._channel = None
         self._stubs = {}
+        self._lock = None  # created lazily on the serving loop
 
     async def on_start(self) -> None:
         import grpc.aio
@@ -128,7 +131,12 @@ class GRPCClient(Service):
         return self._stubs[name]
 
     async def _call(self, kind: str, req):
-        resp = await self._stub(kind)(t.encode_msg(kind, req))
+        import asyncio
+
+        if self._lock is None:
+            self._lock = asyncio.Lock()
+        async with self._lock:
+            resp = await self._stub(kind)(t.encode_msg(kind, req))
         _, res = t.decode_msg(dict(resp), direction=1)
         return res
 

@@ -56,8 +56,16 @@ def _print_response(res) -> None:
                 print(f"-> {extra}: {v}")
 
 
+_ARITY = {"deliver_tx": 1, "check_tx": 1, "query": 1, "set_option": 2}
+
+
 async def _run_command(client, cmd: str, args: list) -> bool:
-    """Execute one console/batch command; False for unknown commands."""
+    """Execute one console/batch command; False for unknown/short commands."""
+    if len(args) < _ARITY.get(cmd, 0):
+        print(
+            f"{cmd}: want {_ARITY[cmd]} argument(s), got {len(args)}", file=sys.stderr
+        )
+        return False
     if cmd == "echo":
         _print_response(await client.echo(args[0] if args else ""))
     elif cmd == "info":
@@ -141,7 +149,11 @@ def cmd_batch(args) -> int:
                 continue
             print(f"> {line}")
             parts = shlex.split(line, posix=False)
-            if not await _run_command(client, parts[0], parts[1:]):
+            try:
+                if not await _run_command(client, parts[0], parts[1:]):
+                    rc = 1
+            except Exception as e:  # a bad line must not abort the batch
+                print(f"error: {e}", file=sys.stderr)
                 rc = 1
         return rc
 

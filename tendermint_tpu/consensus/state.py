@@ -725,6 +725,18 @@ class ConsensusState(Service):
                     missing_power += v.voting_power
             m.missing_validators.set(missing)
             m.missing_validators_power.set(missing_power)
+            byz = byz_power = 0
+            for ev in getattr(block, "evidence", []) or []:
+                byz += 1
+                addr = getattr(ev, "address", None)
+                if callable(addr):  # Evidence.address() is a method
+                    addr = addr()
+                if isinstance(addr, bytes):
+                    _, v = vals.get_by_address(addr)
+                    if v is not None:
+                        byz_power += v.voting_power
+            m.byzantine_validators.set(byz)
+            m.byzantine_validators_power.set(byz_power)
             m.rounds.set(rs.round)
             m.num_txs.set(len(block.txs))
             self._total_txs += len(block.txs)

@@ -405,6 +405,12 @@ class PubkeyTable:
         if tabulated and n > self.TABULATED_MAX_VALIDATORS:
             tabulated = False
         self.tabulated = tabulated
+        # Double-buffered chunking overlaps host prep with device compute —
+        # a win on locally-attached devices (saves ~prep time), but each
+        # extra dispatch pays the host<->device RTT, which on tunnel-attached
+        # TPUs (~100 ms) dwarfs the saving (measured: 495 ms vs 153 ms
+        # single-dispatch for 10k).  Off by default; flip on local hosts.
+        self.chunked_single_shot = False
         self._window_tables = None
         self._interpret = False  # CPU-interpret pallas (tests only)
 
@@ -464,7 +470,7 @@ class PubkeyTable:
             if 0 <= idx < pk_count and self.row_valid[idx]:
                 items[i] = (self.pubkeys[idx], msg, sig)
 
-        if not self.tabulated and n >= 2 * _CHUNK:
+        if self.chunked_single_shot and not self.tabulated and n >= 2 * _CHUNK:
             # Double-buffered single-shot: device dispatch is async, so
             # prepping chunk k+1 on the host while the device runs chunk k
             # hides most of the host prep inside device time — single-shot

@@ -190,6 +190,8 @@ class BlockchainReactor(Reactor):
             "switching to consensus", height=self.state.last_block_height, synced=self.blocks_synced
         )
         self.fast_sync = False
+        if self.consensus_reactor is not None and self.consensus_reactor.cs is not None:
+            self.consensus_reactor.cs.metrics.fast_syncing.set(0)
         if self.consensus_reactor is not None:
             await self.consensus_reactor.switch_to_consensus(self.state, self.blocks_synced)
             # late gossip routines for peers added while syncing

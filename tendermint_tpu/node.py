@@ -266,6 +266,7 @@ class Node(Service):
             self.consensus_reactor = ConsensusReactor(
                 self.consensus, wait_sync=do_fast_sync, async_verifier=self.async_verifier
             )
+            self.consensus.metrics.fast_syncing.set(1 if do_fast_sync else 0)
             self.blockchain_reactor = BlockchainReactor(
                 self.state,
                 block_exec,

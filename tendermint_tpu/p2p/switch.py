@@ -58,6 +58,7 @@ class Switch(Service):
         self.addr_book = None
         self._reconnecting: set = set()
         self._connecting: set = set()
+        self._admitting_inbound: List = []  # (node_id, ip) in-flight tokens
         from ..libs.metrics import P2PMetrics
 
         self.metrics = P2PMetrics()  # nop; node swaps in prometheus
@@ -104,22 +105,39 @@ class Switch(Service):
         while True:
             conn, ni = await self.transport.accept()
             unconditional = ni.node_id in self.unconditional_peer_ids
-            n_inbound = sum(1 for p in self.peers.values() if not p.outbound)
+            # cap/dup-IP checks count IN-FLIGHT admissions too: with
+            # concurrent admission, checking self.peers alone would let a
+            # burst of simultaneous connections bypass both policies
+            n_inbound = (
+                sum(1 for p in self.peers.values() if not p.outbound)
+                + len(self._admitting_inbound)
+            )
             if n_inbound >= self.max_inbound and not unconditional:
                 self.log.info("rejecting inbound: full", peer=ni.node_id[:12])
                 conn.close()
                 continue
+            ip = getattr(conn, "remote_ip", "")
             if not self.allow_duplicate_ip and not unconditional:
-                ip = getattr(conn, "remote_ip", "")
-                if ip and any(p.remote_ip == ip for p in self.peers.values()):
+                if ip and (
+                    any(p.remote_ip == ip for p in self.peers.values())
+                    or any(aip == ip for _, aip in self._admitting_inbound)
+                ):
                     self.log.info("rejecting inbound: duplicate IP", ip=ip)
                     conn.close()
                     continue
             # admit concurrently: peer filters may await (ABCI query, up to
             # 5s each) and must not serialize the accept loop
+            token = (ni.node_id, ip)
+            self._admitting_inbound.append(token)
             self.spawn(
-                self._add_peer_conn(conn, ni, outbound=False), f"admit-{ni.node_id[:8]}"
+                self._admit_inbound(conn, ni, token), f"admit-{ni.node_id[:8]}"
             )
+
+    async def _admit_inbound(self, conn, ni: NodeInfo, token) -> None:
+        try:
+            await self._add_peer_conn(conn, ni, outbound=False)
+        finally:
+            self._admitting_inbound.remove(token)
 
     # -- outbound ----------------------------------------------------------
     async def dial_peer(self, addr: str, persistent: bool = False) -> Optional[Peer]:

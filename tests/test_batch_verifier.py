@@ -372,6 +372,7 @@ class TestChunkedIndexed:
         chunk_verifier = BatchVerifier()
         chunk_verifier._pallas = False  # XLA kernel: any chunk shape allowed
         table = PubkeyTable(pubkeys, chunk_verifier)
+        table.chunked_single_shot = True
         n = 70
         idxs = [i % 12 for i in range(n)]
         ms = [msgs[i] for i in idxs]
