@@ -34,24 +34,61 @@ def encode_record(payload: dict) -> bytes:
     return _HEADER.pack(zlib.crc32(data) & 0xFFFFFFFF, len(data)) + data
 
 
-def decode_records(raw: bytes) -> Iterator[dict]:
-    """Yield records; raises WALCorruptionError on bad crc/length; a
-    truncated tail record (torn write at crash) ends iteration cleanly."""
+# one framing walker serves both replay decode and crash repair — two
+# copies of the subtle header/crc/advance logic would drift
+TORN = "torn"  # incomplete header/payload at EOF (crash mid-write)
+CORRUPT = "corrupt"  # bad crc / absurd length (NOT safely truncatable)
+CLEAN = "clean"  # ends on a record boundary
+
+
+def walk_records(raw: bytes) -> Iterator[tuple]:
+    """Yield ('record', offset, payload_bytes) for each whole record, then
+    exactly one terminal (TORN|CORRUPT|CLEAN, offset, detail)."""
     pos = 0
     n = len(raw)
     while pos < n:
         if n - pos < _HEADER.size:
-            return  # torn header at EOF
+            yield (TORN, pos, "torn header at EOF")
+            return
         crc, length = _HEADER.unpack_from(raw, pos)
         if length > MAX_RECORD_BYTES:
-            raise WALCorruptionError(f"record length {length} exceeds max")
+            yield (CORRUPT, pos, f"record length {length} exceeds max")
+            return
         if n - pos - _HEADER.size < length:
-            return  # torn payload at EOF
+            yield (TORN, pos, "torn payload at EOF")
+            return
         data = raw[pos + _HEADER.size : pos + _HEADER.size + length]
         if zlib.crc32(data) & 0xFFFFFFFF != crc:
-            raise WALCorruptionError(f"crc mismatch at offset {pos}")
-        yield codec.loads(data)
+            yield (CORRUPT, pos, f"crc mismatch at offset {pos}")
+            return
+        yield ("record", pos, data)
         pos += _HEADER.size + length
+    yield (CLEAN, pos, "")
+
+
+def decode_records(raw: bytes) -> Iterator[dict]:
+    """Yield records; raises WALCorruptionError on corruption; a truncated
+    tail record (torn write at crash) ends iteration cleanly."""
+    for kind, pos, data in walk_records(raw):
+        if kind == "record":
+            yield codec.loads(data)
+        elif kind == CORRUPT:
+            raise WALCorruptionError(data)
+        else:  # TORN / CLEAN end iteration quietly
+            return
+
+
+def torn_tail_offset(raw: bytes) -> Optional[int]:
+    """Byte offset of a TORN tail record (incomplete header/payload at
+    EOF — a crash mid-write), or None when the file ends on a record
+    boundary or the problem is corruption (bad crc / absurd length),
+    which must stay loud rather than be truncated away."""
+    for kind, pos, _ in walk_records(raw):
+        if kind == TORN:
+            return pos
+        if kind in (CORRUPT, CLEAN):
+            return None
+    return None
 
 
 class WAL:
@@ -59,6 +96,14 @@ class WAL:
         self.group = Group(head_path, head_size_limit=head_size_limit)
         self.flush_interval = 2.0
         self._last_flush = 0.0
+        # Crash repair: a torn tail record (power loss mid-write) would sit
+        # between old and NEW appends and read as mid-file corruption later.
+        # Truncate exactly the tear; genuine corruption is left in place to
+        # fail loudly at replay (wal.go's decoder likewise skips only
+        # EOF-truncated records).
+        tear = torn_tail_offset(self.group.read_head())
+        if tear is not None:
+            self.group.truncate_head(tear)
 
     # -- writing -----------------------------------------------------------
     def write(self, payload: dict) -> None:

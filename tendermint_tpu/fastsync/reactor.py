@@ -15,6 +15,7 @@ from typing import Optional
 from ..encoding import codec
 from ..libs.log import get_logger
 from ..p2p import ChannelDescriptor, Reactor
+from ..p2p import behaviour
 from ..types import Block, BlockID
 from ..types.params import BLOCK_PART_SIZE_BYTES
 from .processor import Processor
@@ -42,6 +43,9 @@ class BlockchainReactor(Reactor):
         self.fast_sync = fast_sync
         self.consensus_reactor = consensus_reactor
         self.log = get_logger("fastsync")
+        # behaviour reporter (behaviour/reporter.go): peer conduct flows
+        # through one component; tests inject MockReporter
+        self.reporter = None  # SwitchReporter once the switch is known
         start_height = max(block_store.height() + 1, state.last_block_height + 1)
         self.scheduler = Scheduler(start_height)
         self.processor = Processor(start_height)
@@ -76,12 +80,17 @@ class BlockchainReactor(Reactor):
         freed = self.scheduler.remove_peer(peer.id)
         self.processor.drop_heights(freed)
 
+    async def _report(self, b) -> None:
+        if self.reporter is None:
+            self.reporter = behaviour.SwitchReporter(self.switch)
+        await self.reporter.report(b)
+
     # -- receive -----------------------------------------------------------
     async def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
         try:
             kind, msg = _dec(msg_bytes)
         except Exception:
-            await self.switch.stop_peer_for_error(peer, "malformed blockchain message")
+            await self._report(behaviour.bad_message(peer.id, "malformed blockchain message"))
             return
         if kind == "status_request":
             await peer.send(BLOCKCHAIN_CHANNEL, _enc("status_response", {
@@ -98,12 +107,14 @@ class BlockchainReactor(Reactor):
             try:
                 block = Block.deserialize(msg["block"])
             except Exception:
-                await self.switch.stop_peer_for_error(peer, "undecodable block response")
+                await self._report(behaviour.bad_message(peer.id, "undecodable block response"))
                 return
             if self.scheduler.block_received(peer.id, block.height):
                 self.processor.add_block(block.height, block, peer.id)
             else:
-                await self.switch.stop_peer_for_error(peer, "unsolicited block")
+                await self._report(
+                    behaviour.message_out_of_order(peer.id, "unsolicited block")
+                )
         elif kind == "no_block_response":
             self.scheduler.no_block(peer.id, msg["height"])
 
@@ -170,9 +181,8 @@ class BlockchainReactor(Reactor):
                     # re-requested copies are not shadowed by stale ones
                     pid, freed = self.scheduler.block_invalid(h)
                     self.processor.drop_heights(freed)
-                    peer = self.switch.peers.get(pid) if pid else None
-                    if peer is not None:
-                        await self.switch.stop_peer_for_error(peer, "sent invalid block")
+                    if pid:
+                        await self._report(behaviour.bad_message(pid, "sent invalid block"))
                 return
             self.block_store.save_block(
                 first, first.make_part_set(BLOCK_PART_SIZE_BYTES), second.last_commit

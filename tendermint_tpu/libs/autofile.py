@@ -96,6 +96,19 @@ class Group:
     def head_size(self) -> int:
         return self._head.tell()
 
+    def read_head(self) -> bytes:
+        self._head.flush()
+        with open(self.head_path, "rb") as f:
+            return f.read()
+
+    def truncate_head(self, length: int) -> None:
+        """Drop head-file bytes past `length` (torn-tail repair on reopen
+        after a crash: a partial record must not corrupt later appends)."""
+        self._head.flush()
+        self._head.truncate(length)
+        self._head.seek(length)
+        os.fsync(self._head.fileno())
+
     def close(self) -> None:
         if not self._head.closed:
             self._head.flush()

@@ -1,0 +1,73 @@
+"""Flow-rate measurement.
+
+Reference parity: libs/flowrate/flowrate.go (Monitor) — tracks bytes
+transferred, instantaneous and average rates, and peak, for the p2p
+connection status surface (rpc net_info) and fast-sync progress display.
+
+Redesign: the reference's Monitor samples with a mutex-guarded clock; here
+a single-loop-owned exponential moving average over update intervals
+suffices (mconn send/recv routines own their meters)."""
+
+from __future__ import annotations
+
+import time
+
+
+class Meter:
+    """Byte-flow meter with an EMA instantaneous rate."""
+
+    SAMPLE_PERIOD = 0.5  # seconds per EMA sample bucket
+    ALPHA = 0.4  # EMA weight of the newest bucket
+
+    def __init__(self, now: float = None):
+        t = now if now is not None else time.monotonic()
+        self.start = t
+        self.total = 0  # bytes since start
+        self.rate = 0.0  # EMA bytes/sec
+        self.peak = 0.0  # max observed EMA rate
+        self._bucket_start = t
+        self._bucket_bytes = 0
+
+    def update(self, n: int, now: float = None) -> None:
+        t = now if now is not None else time.monotonic()
+        self.total += n
+        self._bucket_bytes += n
+        elapsed = t - self._bucket_start
+        if elapsed >= self.SAMPLE_PERIOD:
+            inst = self._bucket_bytes / elapsed
+            # decay across skipped sample periods so idle links drop to ~0
+            periods = min(int(elapsed / self.SAMPLE_PERIOD), 32)
+            rate = self.rate
+            for _ in range(periods - 1):
+                rate *= 1 - self.ALPHA
+            self.rate = rate * (1 - self.ALPHA) + inst * self.ALPHA
+            self.peak = max(self.peak, self.rate)
+            self._bucket_start = t
+            self._bucket_bytes = 0
+
+    def avg_rate(self, now: float = None) -> float:
+        t = now if now is not None else time.monotonic()
+        dt = t - self.start
+        return self.total / dt if dt > 0 else 0.0
+
+    def cur_rate(self, now: float = None) -> float:
+        """EMA rate decayed to the read time — an idle link reads ~0, not
+        its last burst (the Go Monitor likewise decays on read)."""
+        t = now if now is not None else time.monotonic()
+        idle = t - self._bucket_start
+        periods = min(int(idle / self.SAMPLE_PERIOD), 32)
+        rate = self.rate
+        for _ in range(periods):
+            rate *= 1 - self.ALPHA
+        return rate
+
+    def status(self, now: float = None) -> dict:
+        """flowrate.go Status flavor."""
+        t = now if now is not None else time.monotonic()
+        return {
+            "duration_s": round(t - self.start, 3),
+            "bytes": self.total,
+            "cur_rate": round(self.cur_rate(t), 1),
+            "avg_rate": round(self.avg_rate(t), 1),
+            "peak_rate": round(self.peak, 1),
+        }

@@ -131,7 +131,27 @@ class MConnection(Service):
         self._last_msg_recv = time.monotonic()
         self._send_limiter = _RateLimiter(send_rate)
         self._recv_limiter = _RateLimiter(recv_rate)
+        from ...libs.flowrate import Meter
+
+        self.send_meter = Meter()  # libs/flowrate — net_info ConnectionStatus
+        self.recv_meter = Meter()
         self._stopping = False
+
+    def status(self) -> dict:
+        """conn.ConnectionStatus flavor (connection.go:560)."""
+        return {
+            "send_monitor": self.send_meter.status(),
+            "recv_monitor": self.recv_meter.status(),
+            "channels": [
+                {
+                    "id": ch.desc.id,
+                    "send_queue_size": ch.send_queue.qsize(),
+                    "priority": ch.desc.priority,
+                    "recently_sent": ch.recently_sent,
+                }
+                for ch in self.channels.values()
+            ],
+        }
 
     async def on_start(self) -> None:
         self.spawn(self._send_routine(), "send")
@@ -210,6 +230,7 @@ class MConnection(Service):
     async def _write_packet(self, packet: dict) -> None:
         data = msgpack.packb(packet, use_bin_type=True)
         await self._send_limiter.consume(len(data))
+        self.send_meter.update(len(data))
         await self.conn.write_msg(data)
 
     # -- receiving ---------------------------------------------------------
@@ -221,6 +242,7 @@ class MConnection(Service):
             while True:
                 raw = await self.conn.read_msg(max_size=max_packet)
                 await self._recv_limiter.consume(len(raw))
+                self.recv_meter.update(len(raw))
                 packet = msgpack.unpackb(raw, raw=False)
                 self._last_msg_recv = time.monotonic()
                 t = packet.get("t")

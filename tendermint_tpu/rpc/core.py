@@ -212,6 +212,8 @@ class RPCCore:
                         "moniker": getattr(peer.node_info, "moniker", ""),
                         "is_outbound": getattr(peer, "outbound", False),
                         "remote_addr": getattr(peer, "remote_addr", ""),
+                        # rpc/core/net.go ConnectionStatus (flowrate meters)
+                        "connection_status": peer.mconn.status(),
                     }
                 )
         return {

@@ -173,3 +173,63 @@ class TestFastSyncNet:
             await syncer.stop()
         finally:
             await stop_net(nodes)
+
+
+class TestBehaviourReporting:
+    """behaviour/reporter.go — reactors report conduct through a Reporter;
+    MockReporter captures what was reported."""
+
+    async def test_bad_block_response_reported(self):
+        from tendermint_tpu.fastsync.reactor import BlockchainReactor
+        from tendermint_tpu.p2p.behaviour import BAD_MESSAGE, MockReporter
+
+        class _Peer:
+            id = "peerX"
+
+            async def send(self, *a):
+                return True
+
+        class _Store:
+            def height(self):
+                return 0
+
+            def base(self):
+                return 0
+
+        reactor = BlockchainReactor.__new__(BlockchainReactor)
+        reactor.reporter = MockReporter()
+        reactor.fast_sync = True
+        reactor.block_store = _Store()
+        from tendermint_tpu.fastsync.reactor import BLOCKCHAIN_CHANNEL
+
+        await reactor.receive(BLOCKCHAIN_CHANNEL, _Peer(), b"\x00garbage")
+        reports = reactor.reporter.get("peerX")
+        assert len(reports) == 1 and reports[0].kind == BAD_MESSAGE
+
+    async def test_switch_reporter_stops_bad_and_marks_good(self):
+        from tendermint_tpu.p2p.behaviour import (
+            SwitchReporter,
+            bad_message,
+            consensus_vote,
+        )
+
+        stopped = []
+        marked = []
+
+        class _Book:
+            def mark_good(self, pid):
+                marked.append(pid)
+
+        class _Switch:
+            peers = {"p1": object(), "p2": object()}
+            addr_book = _Book()
+
+            async def stop_peer_for_error(self, peer, reason):
+                stopped.append(reason)
+
+        rep = SwitchReporter(_Switch())
+        assert await rep.report(consensus_vote("p1"))
+        assert marked == ["p1"]
+        assert await rep.report(bad_message("p2", "bad"))
+        assert stopped == ["bad"]
+        assert not await rep.report(bad_message("ghost", "x"))  # unknown peer

@@ -144,3 +144,29 @@ def test_codec_roundtrip():
     out = loads(dumps({"key": pk, "n": 5}))
     assert out["n"] == 5
     assert out["key"] == pk
+
+
+class TestFlowrate:
+    """libs/flowrate/flowrate.go Monitor parity."""
+
+    def test_meter_tracks_rate_and_total(self):
+        from tendermint_tpu.libs.flowrate import Meter
+
+        m = Meter(now=0.0)
+        for i in range(10):
+            m.update(1000, now=0.1 * (i + 1))  # 10 KB over 1s
+        assert m.total == 10_000
+        assert m.avg_rate(now=1.0) == 10_000
+        assert m.rate > 0
+        assert m.peak >= m.rate
+        st = m.status(now=1.0)
+        assert st["bytes"] == 10_000 and st["avg_rate"] == 10_000
+
+    def test_idle_decay(self):
+        from tendermint_tpu.libs.flowrate import Meter
+
+        m = Meter(now=0.0)
+        m.update(100_000, now=0.5)
+        busy = m.rate
+        m.update(1, now=30.0)  # long idle gap
+        assert m.rate < busy / 10
