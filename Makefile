@@ -1,0 +1,34 @@
+# Build/test/bench entry points (reference parity: Makefile).
+PY ?= python
+
+.PHONY: test test-fast bench localnet lint fmt csrc clean abci-cli signer-harness
+
+test:            ## full suite (virtual 8-device CPU mesh)
+	$(PY) -m pytest tests/ -q
+
+test-fast:       ## the quick tiers only
+	$(PY) -m pytest tests/ -q -x --ignore=tests/test_tools.py
+
+bench:           ## BASELINE benchmarks on the attached chip -> one JSON line
+	$(PY) bench.py
+
+localnet:        ## 4-validator net as OS processes (no docker)
+	$(PY) -m tendermint_tpu.cli testnet --validators 4 --output ./build
+	$(PY) networks/local/run_localnet.py ./build
+
+lint:            ## syntax + import sanity over the package
+	$(PY) -m compileall -q tendermint_tpu tests bench.py __graft_entry__.py
+
+csrc:            ## force-rebuild the C host-prep extension
+	rm -f tendermint_tpu/csrc/*.so
+	$(PY) -c "from tendermint_tpu.crypto import hostprep; assert hostprep._load_lib()"
+
+abci-cli:        ## serve the example kvstore app on :26658
+	$(PY) -m tendermint_tpu.abci_cli kvstore
+
+signer-harness:  ## remote signer acceptance tests (listens on :31559)
+	$(PY) -m tendermint_tpu.tools.signer_harness
+
+clean:
+	rm -rf build .pytest_cache tendermint_tpu/csrc/*.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -18,6 +18,7 @@ from typing import Callable, Dict, List, Optional
 
 import msgpack
 
+from ...libs.flowrate import Meter
 from ...libs.log import get_logger
 from ...libs.service import Service
 
@@ -131,8 +132,6 @@ class MConnection(Service):
         self._last_msg_recv = time.monotonic()
         self._send_limiter = _RateLimiter(send_rate)
         self._recv_limiter = _RateLimiter(recv_rate)
-        from ...libs.flowrate import Meter
-
         self.send_meter = Meter()  # libs/flowrate — net_info ConnectionStatus
         self.recv_meter = Meter()
         self._stopping = False
