@@ -61,21 +61,50 @@ class EvidenceReactor(Reactor):
                 await self.switch.stop_peer_for_error(peer, f"invalid evidence: {e}")
                 return
 
+    def _peer_height(self, peer) -> int:
+        """The peer's consensus height via the PeerRoundState the consensus
+        reactor attaches to the peer — the reference's peer.Get(PeerStateKey)
+        pattern (evidence/reactor.go:157)."""
+        ps = peer.get("cs_peer_state")
+        return getattr(ps, "height", 0) if ps is not None else 0
+
     async def _broadcast_routine(self, peer) -> None:
         """reactor.go:107 — event-driven (woken on add_evidence), with a
-        slow fallback rescan instead of a 10 Hz poll per peer."""
+        slow fallback rescan instead of a 10 Hz poll per peer.  Evidence
+        for heights the peer hasn't reached is WITHHELD (not marked sent):
+        the peer could not validate it yet; the rescan retries once the
+        peer catches up (reactor.go:157)."""
         sent: set = set()
         wake = self._peer_events[peer.id]
         while True:
             wake.clear()  # before scanning, so adds during the scan re-set it
-            pending = self.pool.pending_evidence()
-            fresh = [ev for ev in pending if ev.hash() not in sent]
+            peer_h = self._peer_height(peer)
+            fresh, withheld = [], False
+            for ev in self.pool.pending_evidence():
+                if ev.hash() in sent:
+                    continue
+                if ev.height() <= peer_h:
+                    fresh.append(ev)
+                else:
+                    withheld = True
             if fresh:
                 ok = await peer.send(EVIDENCE_CHANNEL, codec.dumps({"evidence": fresh}))
                 if not ok:
                     return
                 sent.update(ev.hash() for ev in fresh)
-            try:
-                await asyncio.wait_for(wake.wait(), BROADCAST_FALLBACK_INTERVAL)
-            except asyncio.TimeoutError:
-                pass
+            if withheld:
+                # catching-up peer: fast-poll ONLY its height (the
+                # reference's peerCatchupSleepInterval); the pool is only
+                # rescanned once the height actually moves or we're woken
+                while True:
+                    try:
+                        await asyncio.wait_for(wake.wait(), 0.1)
+                        break  # new evidence arrived: rescan
+                    except asyncio.TimeoutError:
+                        if self._peer_height(peer) > peer_h:
+                            break  # peer advanced: rescan
+            else:
+                try:
+                    await asyncio.wait_for(wake.wait(), BROADCAST_FALLBACK_INTERVAL)
+                except asyncio.TimeoutError:
+                    pass

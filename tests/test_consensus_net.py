@@ -237,3 +237,71 @@ class TestByzantineEvidence:
             assert found is not None
         finally:
             await stop_net(nodes)
+
+
+class TestEvidenceWithholding:
+    async def test_evidence_withheld_until_peer_catches_up(self, tmp_path):
+        """evidence/reactor.go:157 — evidence for a height the peer hasn't
+        reached is withheld, then delivered once the peer catches up."""
+        import asyncio as _aio
+
+        from tendermint_tpu.evidence_reactor import EvidenceReactor
+        from tendermint_tpu.evidence import EvidencePool
+        from tendermint_tpu.libs.kvstore import open_db
+        from tendermint_tpu.state.store import StateStore
+
+        sent_batches = []
+
+        class _PS:
+            height = 3
+
+        class _Peer:
+            id = "peer-ev"
+
+            def get(self, key):
+                # the consensus reactor publishes PeerRoundState on the peer
+                return _PS() if key == "cs_peer_state" else None
+
+            async def send(self, chan, msg):
+                from tendermint_tpu.encoding import codec
+
+                sent_batches.append(codec.loads(msg)["evidence"])
+                return True
+
+        from tendermint_tpu.types import BlockID, PartSetHeader, Vote
+        from tendermint_tpu.types.canonical import PREVOTE_TYPE
+        from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+        pv = MockPV()
+
+        def _vote(blk):
+            v = Vote(
+                type=PREVOTE_TYPE, height=5, round=0,
+                block_id=BlockID(blk, PartSetHeader(1, b"\x02" * 32)),
+                timestamp_ns=1, validator_address=pv.address(), validator_index=0,
+            )
+            pv.sign_vote(CHAIN_ID, v)
+            return v
+
+        ev = DuplicateVoteEvidence.from_votes(
+            pv.get_pub_key(), _vote(b"\x01" * 32), _vote(b"\x03" * 32)
+        )
+        state_db = open_db("state", None, "memdb")
+        pool = EvidencePool(open_db("ev", None, "memdb"), StateStore(state_db))
+        pool.pending_evidence = lambda max_num=-1: [ev]
+
+        reactor = EvidenceReactor(pool)
+
+        peer = _Peer()
+        await reactor.start()
+        try:
+            await reactor.add_peer(peer)
+            await _aio.sleep(0.3)
+            assert sent_batches == []  # withheld: peer at 3 < ev height 5
+            _PS.height = 6  # peer caught up
+            await _aio.sleep(0.3)  # catchup retry interval is 0.1s
+            assert len(sent_batches) == 1 and sent_batches[0][0].hash() == ev.hash()
+            await _aio.sleep(0.3)
+            assert len(sent_batches) == 1  # not re-sent
+        finally:
+            await reactor.stop()
