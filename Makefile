@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -11,6 +11,9 @@ test-fast:       ## the quick tiers only
 
 bench:           ## BASELINE benchmarks on the attached chip -> one JSON line
 	$(PY) bench.py
+
+bench-smoke:     ## small-batch engine regression tripwire (~1 min, asserts budgets)
+	$(PY) bench.py --smoke
 
 localnet:        ## 4-validator net as OS processes (no docker)
 	$(PY) -m tendermint_tpu.cli testnet --validators 4 --output ./build

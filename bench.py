@@ -21,28 +21,40 @@ through a live node, 100-validator commit verify, lite2 bisection,
 sr25519, multisig.
 """
 
+import argparse
 import asyncio
 import json
+import os
 import time
 
 import numpy as np
 
+# persistent XLA compile cache (shared with the test suite and localnet
+# node processes): repeat bench runs skip minutes of identical compiles
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_tendermint_tpu")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
-def bench_primary():
+
+def bench_primary(n_vals: int = 10_000):
     """10k-validator commit batch: latency + steady-state + breakdown.
 
     Measures the engine's ACTIVE steady-state path: on a TPU backend that is
     the tabulated zero-doubling kernel (ops/ed25519_table.py — per-validator
     window tables in HBM, 128 gathered adds per signature, no ladder); on
     CPU/mesh it is the fused gather + Straus kernel.  Table build time is
-    reported separately (one-time per validator-set change)."""
+    reported separately (one-time per validator-set change).
+
+    Also reports the host<->device dispatch RTT probe and BOTH single-shot
+    flavors — monolithic (one dispatch) and double-buffered chunked (prep
+    of chunk k+1 overlaps device compute of chunk k) — plus which one the
+    probe auto-selects, so the chunked path is a measured number instead of
+    a dormant code path."""
     import jax
 
     from tendermint_tpu.crypto import batch_verifier as bv
+    from tendermint_tpu.crypto import hostprep
     from tendermint_tpu.crypto.batch_verifier import BatchVerifier, PubkeyTable
     from tendermint_tpu.crypto.keys import Ed25519PrivKey, Ed25519PubKey
-
-    n_vals = 10_000
     keys = [Ed25519PrivKey.from_secret(b"bench-%d" % i) for i in range(n_vals)]
     pubkeys = [k.pub_key().bytes() for k in keys]
     msgs = [
@@ -61,14 +73,26 @@ def bench_primary():
     ok = table.verify_indexed(idxs, msgs, sigs)  # warmup/compile
     assert all(ok), "bench batch failed to verify"
 
-    # single-shot latency: full host prep + dispatch + fetch, nothing
-    # amortized (min over runs: co-tenant contention spikes)
-    lat = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        table.verify_indexed(idxs, msgs, sigs)
-        lat.append(time.perf_counter() - t0)
-    latency_ms = min(lat) * 1000
+    # dispatch RTT probe: decides (and reports) whether chunked overlap pays
+    probe = table.verifier.probe_dispatch_rtt()
+
+    # single-shot latency, BOTH flavors: full host prep + dispatch + fetch,
+    # nothing amortized (min over runs: co-tenant contention spikes)
+    def _timed_single_shot(chunked):
+        table.chunked_single_shot = chunked
+        lat = []
+        table.verify_indexed(idxs, msgs, sigs)  # compile/warm this flavor
+        for _ in range(5):
+            t0 = time.perf_counter()
+            table.verify_indexed(idxs, msgs, sigs)
+            lat.append(time.perf_counter() - t0)
+        return min(lat) * 1000
+
+    mono_ms = _timed_single_shot(False)
+    chunked_ms = _timed_single_shot(True) if n_vals >= 2 * bv._CHUNK else mono_ms
+    table.chunked_single_shot = None  # back to probe-driven auto
+    auto_chunked = table.verifier.chunked_auto()
+    latency_ms = chunked_ms if (auto_chunked and n_vals >= 2 * bv._CHUNK) else mono_ms
 
     # host prep share
     items = [(pubkeys[i], msgs[i], sigs[i]) for i in range(n_vals)]
@@ -130,8 +154,14 @@ def bench_primary():
         "vs_baseline": sigs_per_sec / host_sigs_per_sec,
         "batch_ms_per_10k_commit": steady_ms,
         "single_shot_latency_ms": latency_ms,
+        "single_shot_monolithic_ms": mono_ms,
+        "single_shot_chunked_ms": chunked_ms,
+        "chunked_auto_selected": bool(auto_chunked),
+        "dispatch_rtt_ms": probe["dispatch_rtt_ms"],
+        "prep_ms_per_chunk": probe["prep_ms_per_chunk"],
         "steady_device_ms": steady_device_ms,
         "host_prep_ms": host_prep_ms,
+        "host_prep_fused_c": bool(hostprep.have_fast_prep()),
         "host_serial_sigs_per_sec": host_sigs_per_sec,
         "tabulated_kernel": bool(table.tabulated),
         "table_build_ms": table_build_ms,
@@ -189,11 +219,17 @@ async def bench_e2e_commits():
     from tendermint_tpu.node import Node
     from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
 
+    from tendermint_tpu.types.params import BlockParams, ConsensusParams
+
     pv = MockPV()
     gen = GenesisDoc(
         chain_id="bench-e2e",
         genesis_time_ns=time.time_ns(),
         validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10)],
+        # iota=1ms: at 100+ commits/sec the default 1000 ms BFT-time step
+        # would race block time ahead of wall clock and trip the
+        # propose-side clock-drift guard (and lite2's) within seconds
+        consensus_params=ConsensusParams(block=BlockParams(time_iota_ms=1)),
     )
     with tempfile.TemporaryDirectory() as home:
         cfg = make_test_cfg(home)
@@ -225,11 +261,14 @@ async def bench_e2e_4val():
     from tendermint_tpu.node import Node
     from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
 
+    from tendermint_tpu.types.params import BlockParams, ConsensusParams
+
     pvs = sorted([MockPV() for _ in range(4)], key=lambda pv: pv.address())
     gen = GenesisDoc(
         chain_id="bench-4val",
         genesis_time_ns=time.time_ns(),
         validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
+        consensus_params=ConsensusParams(block=BlockParams(time_iota_ms=1)),
     )
     with tempfile.TemporaryDirectory() as home:
         nodes = []
@@ -264,6 +303,83 @@ async def bench_e2e_4val():
             for node in nodes:
                 if node.is_running:
                     await node.stop()
+
+
+def bench_e2e_4val_procs(duration: float = 12.0):
+    """BASELINE config #1 measured HONESTLY: 4 validator nodes as separate
+    OS processes (own interpreter, own event loop, own JAX runtime), real
+    TCP gossip on localhost, throughput-rig configs (`testnet --fast`:
+    test-grade timeouts, skip_timeout_commit, time_iota_ms=1 genesis).
+    Readiness-gated by networks/local/run_localnet.py: the clock starts
+    only after every node's RPC reports height >= 1, so per-process JAX
+    cold start is excluded.  Returns the run_localnet JSON result."""
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    def _free_base_port():
+        # testnet uses base+10i (p2p) and base+10i+1 (rpc) for i<4
+        for _ in range(20):
+            base = int.from_bytes(os.urandom(2), "big") % 30000 + 20000
+            socks = []
+            try:
+                for off in range(0, 40, 10):
+                    for d in (0, 1):
+                        s = socket.socket()
+                        socks.append(s)  # before bind: close it even on failure
+                        s.bind(("127.0.0.1", base + off + d))
+                return base
+            except OSError:
+                continue
+            finally:
+                for s in socks:
+                    s.close()
+        raise RuntimeError("no free port range found")
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as tmp:
+        build = os.path.join(tmp, "build")
+        subprocess.run(
+            [sys.executable, "-m", "tendermint_tpu.cli", "testnet",
+             "--validators", "4", "--output", build,
+             "--base-port", str(_free_base_port()), "--fast"],
+            check=True, capture_output=True, timeout=120, cwd=repo,
+        )
+        run = subprocess.run(
+            [sys.executable, os.path.join(repo, "networks", "local", "run_localnet.py"),
+             build, "--duration", str(duration), "--json"],
+            capture_output=True, text=True, timeout=duration + 150, cwd=repo,
+        )
+        if run.returncode != 0:
+            raise RuntimeError(f"localnet run failed:\n{run.stdout}\n{run.stderr}")
+        return json.loads(run.stdout.strip().splitlines()[-1])
+
+
+async def bench_vote_hop_flush():
+    """Latency a SINGLE sparse vote pays in the AsyncBatchVerifier before
+    its flush fires (the per-hop quantum the adaptive window shrinks) — at
+    4 validators every vote rides this path, twice per block."""
+    from tendermint_tpu.crypto.batch_verifier import AsyncBatchVerifier, BatchVerifier
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+
+    k = Ed25519PrivKey.from_secret(b"hop")
+    msg = b"\x08\x02\x11" + bytes(80)
+    sig = k.sign(msg)
+    svc = AsyncBatchVerifier(BatchVerifier())
+    await svc.start()
+    try:
+        assert await svc.verify_one(k.pub_key().bytes(), msg, sig)  # warm
+        times = []
+        for _ in range(20):
+            await asyncio.sleep(0.01)  # let the queue go idle (sparse regime)
+            t0 = time.perf_counter()
+            assert await svc.verify_one(k.pub_key().bytes(), msg, sig)
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        return times[len(times) // 2] * 1000  # median
+    finally:
+        await svc.stop()
 
 
 async def bench_vote_ingest_100val():
@@ -389,8 +505,68 @@ async def bench_lite2():
         batch_hook.set_verifier(None)
 
 
+def _e2e_breakdown(procs: dict, hop_ms: float) -> str:
+    """One-paragraph accounting of where each committed block's
+    milliseconds go in the 4-validator multi-process run."""
+    cps = procs.get("commits_per_sec", 0) or 0.001
+    block_ms = 1000.0 / cps
+    return (
+        f"4-val procs: {cps:.1f} commits/sec = {block_ms:.1f} ms/block on "
+        f"{os.cpu_count()} cores. "
+        f"Consensus timeouts contribute ~0 (skip_timeout_commit, timeout_commit=0). "
+        f"Per block: proposal + parts + 2 vote rounds ride the 5 ms "
+        f"peer-gossip quantum (~3 hops of latency floor), votes verify on "
+        f"the serial C host path (~0.15 ms/sig; batches of 4 are below "
+        f"min_device_batch, so the rig runs engine-off — an idle engine's "
+        f"warmup compiles stole cores from co-located nodes), and the "
+        f"sparse-regime adaptive flush hop measures {hop_ms:.2f} ms "
+        f"(vs 2 ms fixed-quantum before). The remainder is block "
+        f"exec/store (live-path validator set reused; the O(height) "
+        f"proposer-priority replay per block is gone) and msgpack "
+        f"encode/decode per peer hop, measured over "
+        f"{procs.get('blocks', '?')} blocks in {procs.get('measure_s', '?')} s "
+        f"with 4 interpreters sharing this host's cores."
+    )
+
+
 def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--smoke",
+        action="store_true",
+        help="small-batch regression tripwire: primary engine numbers only "
+        "(2k batch, no e2e nets), asserts host-prep and correctness budgets",
+    )
+    args = ap.parse_args()
+
+    if args.smoke:
+        primary = bench_primary(n_vals=2048)
+        out = {
+            "metric": "bench_smoke",
+            "value": round(primary["sigs_per_sec"], 1),
+            "unit": "sigs/sec",
+            "host_prep_ms_2k": round(primary["host_prep_ms"], 2),
+            "host_prep_fused_c": primary["host_prep_fused_c"],
+            "dispatch_rtt_ms": round(primary["dispatch_rtt_ms"], 3),
+            "chunked_auto_selected": primary["chunked_auto_selected"],
+            "single_shot_latency_ms": round(primary["single_shot_latency_ms"], 2),
+            "vote_hop_flush_ms": round(asyncio.run(bench_vote_hop_flush()), 3),
+        }
+        print(json.dumps(out))
+        # tripwire: fused prep must stay under the 10k budget pro-rated
+        # (15 ms / 10k = 3.1 ms at 2048) with headroom for CI-host noise
+        if primary["host_prep_fused_c"]:
+            assert primary["host_prep_ms"] < 8.0, (
+                f"host prep regressed: {primary['host_prep_ms']:.2f} ms at 2048 sigs"
+            )
+        return
+
     primary = bench_primary()
+    hop_ms = asyncio.run(bench_vote_hop_flush())
+    try:
+        procs = bench_e2e_4val_procs()
+    except Exception as e:  # the rig must not sink the whole bench report
+        procs = {"commits_per_sec": -1.0, "error": str(e)[:300]}
     extras = {
         "commit_verify_100val_ms": bench_100val_commit(),
         "e2e_commits_per_sec_solo": asyncio.run(bench_e2e_commits()),
@@ -408,11 +584,21 @@ def main() -> None:
         "method": "steady-state pipelined (K=10, fetch-last); single-shot latency separate",
         "batch_ms_per_10k_commit": round(primary["batch_ms_per_10k_commit"], 2),
         "single_shot_latency_ms": round(primary["single_shot_latency_ms"], 2),
+        "single_shot_monolithic_ms": round(primary["single_shot_monolithic_ms"], 2),
+        "single_shot_chunked_ms": round(primary["single_shot_chunked_ms"], 2),
+        "chunked_auto_selected": primary["chunked_auto_selected"],
+        "dispatch_rtt_ms": round(primary["dispatch_rtt_ms"], 3),
+        "prep_ms_per_chunk": round(primary["prep_ms_per_chunk"], 2),
         "steady_device_ms": round(primary["steady_device_ms"], 2),
         "host_prep_ms": round(primary["host_prep_ms"], 2),
+        "host_prep_fused_c": primary["host_prep_fused_c"],
         "host_serial_sigs_per_sec": round(primary["host_serial_sigs_per_sec"], 1),
         "tabulated_kernel": primary["tabulated_kernel"],
         "table_build_ms": round(primary["table_build_ms"], 1),
+        "e2e_commits_per_sec_4val_procs": round(procs.get("commits_per_sec", -1.0), 2),
+        "e2e_4val_procs_startup_s": procs.get("startup_s"),
+        "vote_hop_flush_ms": round(hop_ms, 3),
+        "e2e_4val_breakdown": _e2e_breakdown(procs, hop_ms),
         **{k: round(v, 2) for k, v in extras.items()},
     }
     print(json.dumps(out))

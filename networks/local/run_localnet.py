@@ -2,13 +2,22 @@
 """Run a generated testnet as real OS processes (no docker needed).
 
 Usage:
-    python -m tendermint_tpu.cli testnet --validators 4 --output ./build
-    python networks/local/run_localnet.py ./build [--duration 30]
+    python -m tendermint_tpu.cli testnet --validators 4 --output ./build [--fast]
+    python networks/local/run_localnet.py ./build [--duration 30] [--json]
 
-Spawns one `tendermint_tpu node` process per node directory, polls every
-node's RPC for height, prints progress, and tears everything down on
-Ctrl-C or after --duration seconds.  Exit code 0 iff every node committed
-at least 3 blocks and all heads agree within 2 heights.
+Spawns one `tendermint_tpu node` process per node directory (RPC/P2P ports
+are read from each node's config.toml — no port arithmetic, so generators
+can use any free ports), waits until EVERY node's RPC answers with height
+>= 1 (readiness gate: per-process JAX import + XLA warmup takes seconds
+and must not eat into the measurement window), then measures committed
+blocks per second over --duration seconds of wall clock.
+
+Exit code 0 iff every node committed at least 3 blocks and all heads agree
+within 2 heights.  With --json, the last stdout line is a JSON object:
+{"commits_per_sec", "blocks", "measure_s", "startup_s", "heights"} —
+the e2e_commits_per_sec_4val_procs number bench.py reports (BASELINE
+config #1 measured from real multi-process nodes, not one shared event
+loop).
 """
 
 import argparse
@@ -20,17 +29,45 @@ import sys
 import time
 import urllib.request
 
+try:
+    import tomllib
+except ImportError:  # Python < 3.11
+    import tomli as tomllib
+
 
 def rpc(port: int, path: str):
     with urllib.request.urlopen(f"http://127.0.0.1:{port}/{path}", timeout=2) as r:
         return json.load(r)
 
 
+def rpc_port_of(home: str) -> int:
+    with open(os.path.join(home, "config", "config.toml"), "rb") as f:
+        laddr = tomllib.load(f)["rpc"]["laddr"]
+    # "tcp://127.0.0.1:26657" or "127.0.0.1:26657"
+    return int(laddr.rsplit(":", 1)[1])
+
+
+def poll_heights(rpc_ports) -> list:
+    heights = []
+    for port in rpc_ports:
+        try:
+            heights.append(
+                int(rpc(port, "status")["result"]["sync_info"]["latest_block_height"])
+            )
+        except Exception:
+            heights.append(-1)
+    return heights
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("build_dir")
-    ap.add_argument("--duration", type=float, default=30.0)
-    ap.add_argument("--base-port", type=int, default=26656)
+    ap.add_argument("--duration", type=float, default=30.0,
+                    help="measurement window AFTER all nodes are ready")
+    ap.add_argument("--startup-timeout", type=float, default=90.0,
+                    help="max wait for every node's RPC to report height >= 1")
+    ap.add_argument("--json", action="store_true",
+                    help="print a JSON result line (commits/sec) at the end")
     args = ap.parse_args()
 
     homes = sorted(
@@ -41,9 +78,13 @@ def main() -> int:
     if not homes:
         print(f"no node*/ directories under {args.build_dir}", file=sys.stderr)
         return 2
-    rpc_ports = [args.base_port + 10 * i + 1 for i in range(len(homes))]
+    rpc_ports = [rpc_port_of(home) for home in homes]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # all nodes compile identical XLA kernels — share one persistent cache
+    # so only the first process (ever) pays each compile
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_tendermint_tpu")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     procs = [
         subprocess.Popen(
             [sys.executable, "-m", "tendermint_tpu.cli", "--home", home, "node"],
@@ -53,25 +94,56 @@ def main() -> int:
         )
         for home in homes
     ]
-    print(f"spawned {len(procs)} nodes; polling for {args.duration:.0f}s")
+    print(f"spawned {len(procs)} nodes; waiting for all RPCs to reach height 1")
     ok = False
+    result = {}
     try:
-        deadline = time.time() + args.duration
-        while time.time() < deadline:
-            time.sleep(2)
-            heights = []
-            for port in rpc_ports:
-                try:
-                    heights.append(
-                        int(rpc(port, "status")["result"]["sync_info"]["latest_block_height"])
-                    )
-                except Exception:
-                    heights.append(-1)
-            print("heights:", heights)
-            if min(heights) >= 3 and max(heights) - min(heights) <= 2:
-                print("localnet healthy: all nodes committing in lock-step")
-                ok = True
+        # readiness gate: the duration clock starts only once every node is
+        # serving RPC and has committed its first block
+        t_start = time.time()
+        ready_deadline = t_start + args.startup_timeout
+        while time.time() < ready_deadline:
+            heights = poll_heights(rpc_ports)
+            if min(heights) >= 1:
                 break
+            if any(p.poll() is not None for p in procs):
+                print("a node process exited during startup", file=sys.stderr)
+                return 1
+            time.sleep(0.5)
+        else:
+            print(f"startup timeout: heights {poll_heights(rpc_ports)}", file=sys.stderr)
+            return 1
+        startup_s = time.time() - t_start
+
+        # the gate's heights are already validated (all >= 1); a fresh poll
+        # could transiently fail to -1 under load and corrupt the baseline
+        start_heights = heights
+        t0 = time.time()
+        deadline = t0 + args.duration
+        while time.time() < deadline:
+            time.sleep(min(2.0, max(0.1, deadline - time.time())))
+            heights = poll_heights(rpc_ports)
+            print("heights:", heights)
+        # retry any RPC that failed on the final poll — a single timed-out
+        # status call must not turn the headline commits/sec negative
+        for _ in range(5):
+            if min(heights) >= 0:
+                break
+            time.sleep(0.5)
+            retried = poll_heights(rpc_ports)
+            heights = [max(a, b) for a, b in zip(heights, retried)]
+        measure_s = time.time() - t0
+        blocks = min(heights) - min(start_heights)
+        result = {
+            "commits_per_sec": round(blocks / measure_s, 2),
+            "blocks": blocks,
+            "measure_s": round(measure_s, 2),
+            "startup_s": round(startup_s, 2),
+            "heights": heights,
+        }
+        if min(heights) >= 3 and max(heights) - min(heights) <= 2:
+            print("localnet healthy: all nodes committing in lock-step")
+            ok = True
     except KeyboardInterrupt:
         pass
     finally:
@@ -82,6 +154,8 @@ def main() -> int:
                 p.wait(10)
             except subprocess.TimeoutExpired:
                 p.kill()
+    if args.json and result:
+        print(json.dumps(result))
     return 0 if ok else 1
 
 

@@ -92,13 +92,19 @@ def cmd_run(args) -> int:
 def cmd_testnet(args) -> int:
     """commands/testnet.go — an N-validator config tree under --output;
     every node lists every other as a persistent peer (the docker-compose
-    localnet topology on localhost ports)."""
+    localnet topology on localhost ports).
+
+    `--fast` writes throughput-rig configs: test-grade consensus timeouts
+    with skip_timeout_commit (the config.go:792 TestConfig shape) and a
+    genesis with time_iota_ms=1 so block time cannot outrun wall clock
+    when commits are sub-second (the lite2 clock-drift flake class)."""
     from .p2p.key import NodeKey
     from .privval.file import load_or_gen_file_pv
 
     n = args.validators
     out = os.path.abspath(args.output)
     chain_id = args.chain_id or f"testnet-{os.urandom(3).hex()}"
+    fast = getattr(args, "fast", False)
     homes, pvs, node_keys = [], [], []
     for i in range(n):
         home = os.path.join(out, f"node{i}")
@@ -109,10 +115,16 @@ def cmd_testnet(args) -> int:
         node_keys.append(NodeKey.load_or_gen(cfg.node_key_file()))
         homes.append(home)
 
+    consensus_params = None
+    if fast:
+        from .types.params import BlockParams, ConsensusParams
+
+        consensus_params = ConsensusParams(block=BlockParams(time_iota_ms=1))
     genesis = GenesisDoc(
         chain_id=chain_id,
         genesis_time_ns=time.time_ns(),
         validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
+        consensus_params=consensus_params,
     )
     base_port = args.base_port
     docker = getattr(args, "populate_docker_addresses", False)
@@ -134,6 +146,28 @@ def cmd_testnet(args) -> int:
                 f"{node_keys[j].id}@127.0.0.1:{base_port + 10 * j}" for j in range(n) if j != i
             )
         cfg.p2p.allow_duplicate_ip = True
+        if fast:
+            cfg.base.fast_sync = False
+            cfg.base.db_backend = args.db_backend or "memdb"
+            # Small-net rig: every vote batch is below min_device_batch
+            # (16), so the device engine would never fire — but each node
+            # loading JAX + background-compiling warmup kernels steals the
+            # very cores the co-located nodes run on and distorts the
+            # commits/sec measurement.  Verification rides the same serial
+            # C host path the engine itself routes tiny batches to.
+            cfg.tpu.enabled = False
+            cfg.consensus.timeout_propose = 0.1
+            cfg.consensus.timeout_propose_delta = 0.002
+            cfg.consensus.timeout_prevote = 0.02
+            cfg.consensus.timeout_prevote_delta = 0.002
+            cfg.consensus.timeout_precommit = 0.02
+            cfg.consensus.timeout_precommit_delta = 0.002
+            cfg.consensus.timeout_commit = 0.0
+            cfg.consensus.skip_timeout_commit = True
+            cfg.consensus.peer_gossip_sleep_duration = 0.005
+            cfg.consensus.peer_query_maj23_sleep_duration = 0.25
+        elif args.db_backend:
+            cfg.base.db_backend = args.db_backend
         _write_cfg(cfg)
         genesis.save_as(cfg.genesis_file())
     print(f"Successfully initialized {n} node directories in {out} (chain_id={chain_id})")
@@ -317,6 +351,13 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="wire peers for the docker-compose localnet (192.167.10.x)",
     )
+    sp.add_argument(
+        "--fast",
+        action="store_true",
+        help="throughput-rig configs: test-grade timeouts, skip_timeout_commit, "
+        "time_iota_ms=1 genesis, memdb",
+    )
+    sp.add_argument("--db-backend", choices=["sqlite", "memdb"], default="")
     sp.set_defaults(fn=cmd_testnet)
 
     sp = sub.add_parser("gen_validator", help="generate a validator keypair")

@@ -117,6 +117,10 @@ class ConsensusConfig:
     create_empty_blocks_interval: float = 0.0
     peer_gossip_sleep_duration: float = 0.1
     peer_query_maj23_sleep_duration: float = 2.0
+    # Propose-side clock sanity (seconds): prevote nil on proposals whose
+    # header time is further than this past local now — the node-side twin
+    # of lite2's max_clock_drift (defaultMaxClockDrift, 10 s).  0 disables.
+    proposal_clock_drift: float = 10.0
 
     def propose(self, round_: int) -> float:
         """config.go:815 — base + delta·round."""
@@ -146,7 +150,9 @@ class TPUConfig:
     consensus reactor's vote ingress."""
 
     enabled: bool = True
-    flush_interval: float = 0.002  # async batcher deadline (seconds)
+    flush_interval: float = 0.002  # async batcher coalescing cap (seconds)
+    flush_min: float = 0.0002  # adaptive quiet-window floor (seconds)
+    flush_adaptive: bool = True  # arrival-rate-adaptive flush quantum
     max_batch: int = 4096
     mesh_devices: int = 0  # 0 = single device; N>1 shards the batch axis
     min_device_batch: int = 16  # below this, serial host verify wins
@@ -299,7 +305,11 @@ def save_config(cfg: Config, path: str) -> None:
 
 def load_config(path: str, home: Optional[str] = None) -> Config:
     import dataclasses
-    import tomllib
+
+    try:
+        import tomllib
+    except ImportError:  # Python < 3.11
+        import tomli as tomllib
 
     with open(path, "rb") as fh:
         data = tomllib.load(fh)

@@ -132,18 +132,24 @@ class PeerRoundState:
             bits.set_index(index, True)
 
     def apply_vote_set_bits(self, msg: dict, our_votes: Optional[BitArray]) -> None:
+        """reactor.go ApplyVoteSetBitsMessage: the peer's response is the
+        TRUTH for the claimed vote set — replace that slice of our belief,
+        `(existing − ourVotes) ∪ theirBits`, keeping only the bits outside
+        the set.  This must be able to CLEAR bits: a vote we marked as
+        delivered that the peer never received (send raced a disconnect,
+        message lost in a lossy link) is otherwise never re-gossiped, and
+        a node missing one prevote wedges at step PREVOTE with no timeout
+        pending — the maj23/VoteSetBits exchange is the designed repair."""
         bits = BitArray.from_bytes(msg["votes"])
         existing = self.get_vote_bits(msg["height"], msg["round"], msg["type"], bits.bits)
-        if existing is not None:
-            if our_votes is not None:
-                # update = ours AND theirs, OR'd in (reactor.go ApplyVoteSetBitsMessage)
-                have = our_votes.and_(bits)
-                merged = existing.or_(have)
-                existing._v[: merged.bits] = merged._v[: existing.bits]
-            else:
-                table = self.prevotes if msg["type"] == PREVOTE_TYPE else self.precommits
-                if msg["height"] == self.height:
-                    table[msg["round"]] = bits
+        if existing is None:
+            return
+        n = min(existing.bits, bits.bits)
+        if our_votes is not None:
+            merged = existing.sub(our_votes).or_(bits)
+        else:
+            merged = bits
+        existing._v[:n] = merged._v[:n]
 
 
 class ConsensusReactor(Reactor):
@@ -421,22 +427,27 @@ class ConsensusReactor(Reactor):
                     continue
                 await asyncio.sleep(sleep)
                 continue
-            # 3. send the proposal (+POL) if the peer lacks it
-            if rs.proposal is not None and rs.height == ps.height and not ps.proposal:
+            # 3. send the proposal (+POL) if the peer lacks it.  Snapshot
+            # the proposal: rs is mutated in place by the consensus task,
+            # so after any await it may have moved height (proposal=None) —
+            # re-reading rs.proposal across the sends crashed this routine
+            # (and a dead gossip-data task wedges the peer under loss).
+            proposal = rs.proposal
+            if proposal is not None and rs.height == ps.height and not ps.proposal:
                 if rs.round == ps.round:
                     ok = await peer.send(
-                        DATA_CHANNEL, _enc("proposal", {"proposal": rs.proposal.to_dict()})
+                        DATA_CHANNEL, _enc("proposal", {"proposal": proposal.to_dict()})
                     )
                     if not ok:
                         await asyncio.sleep(sleep)
                         continue
-                    ps.set_has_proposal(rs.proposal)
-                    if 0 <= rs.proposal.pol_round:
-                        pol = rs.votes.prevotes(rs.proposal.pol_round)
+                    ps.set_has_proposal(proposal)
+                    if 0 <= proposal.pol_round:
+                        pol = rs.votes.prevotes(proposal.pol_round)
                         if pol is not None:
                             await peer.send(DATA_CHANNEL, _enc("proposal_pol", {
-                                "height": rs.height,
-                                "proposal_pol_round": rs.proposal.pol_round,
+                                "height": proposal.height,
+                                "proposal_pol_round": proposal.pol_round,
                                 "proposal_pol": pol.bit_array().to_bytes(),
                             }))
                     continue
@@ -454,10 +465,12 @@ class ConsensusReactor(Reactor):
         meta = self.cs.block_store.load_block_meta(ps.height)
         if meta is None or ps.proposal_block_parts_header != meta.block_id.parts_header:
             return False
-        full = BitArray.from_indices(
-            ps.proposal_block_parts.bits, range(ps.proposal_block_parts.bits)
-        )
-        missing = full.sub(ps.proposal_block_parts)
+        # snapshot: a NewRoundStep arriving during the send resets
+        # ps.proposal_block_parts to None (same in-place-mutation trap as
+        # the proposal send above; a crashed gossip task wedges the peer)
+        parts = ps.proposal_block_parts
+        full = BitArray.from_indices(parts.bits, range(parts.bits))
+        missing = full.sub(parts)
         idx = missing.pick_random()
         if idx is None:
             return False
@@ -468,7 +481,7 @@ class ConsensusReactor(Reactor):
             "height": ps.height, "round": ps.round, "part": part.to_dict(),
         }))
         if ok:
-            ps.proposal_block_parts.set_index(idx, True)
+            parts.set_index(idx, True)
         return ok
 
     async def _gossip_votes_routine(self, peer, ps: PeerRoundState) -> None:
@@ -562,20 +575,35 @@ class ConsensusReactor(Reactor):
         while True:
             await asyncio.sleep(sleep)
             rs = self.cs.rs
-            if rs.votes is None or rs.height != ps.height:
+            if rs.votes is not None and rs.height == ps.height:
+                for vote_type, getter in (
+                    (PREVOTE_TYPE, rs.votes.prevotes),
+                    (PRECOMMIT_TYPE, rs.votes.precommits),
+                ):
+                    vs = getter(ps.round if ps.round >= 0 else rs.round)
+                    if vs is None:
+                        continue
+                    maj23, ok = vs.two_thirds_majority()
+                    if ok:
+                        await peer.send(STATE_CHANNEL, _enc("vote_set_maj23", {
+                            "height": rs.height, "round": vs.round, "type": vote_type,
+                            "block_id": maj23.to_dict(),
+                        }))
                 continue
-            for vote_type, getter in (
-                (PREVOTE_TYPE, rs.votes.prevotes),
-                (PRECOMMIT_TYPE, rs.votes.precommits),
-            ):
-                vs = getter(ps.round if ps.round >= 0 else rs.round)
-                if vs is None:
-                    continue
-                maj23, ok = vs.two_thirds_majority()
-                if ok:
+            # Catchup-commit claim (reference reactor.go:783): the peer is
+            # on an earlier height whose commit we store — claiming its
+            # maj23 makes the peer answer with its REAL precommit bits,
+            # repairing any falsely-marked last-commit bits in our
+            # PeerRoundState so _send_commit_vote resends what they
+            # actually lack.  Without this, one phantom-delivered commit
+            # vote leaves a lagging peer stuck one height behind forever.
+            if 0 < ps.height < rs.height and ps.height >= self.cs.block_store.base():
+                commit = self.cs.block_store.load_block_commit(ps.height)
+                if commit is not None:
                     await peer.send(STATE_CHANNEL, _enc("vote_set_maj23", {
-                        "height": rs.height, "round": vs.round, "type": vote_type,
-                        "block_id": maj23.to_dict(),
+                        "height": ps.height, "round": commit.round,
+                        "type": PRECOMMIT_TYPE,
+                        "block_id": commit.block_id.to_dict(),
                     }))
 
 

@@ -175,6 +175,18 @@ class ConsensusState(Service):
         self.schedule_round0()
 
     async def on_stop(self) -> None:
+        # Quiesce the receive/pump tasks BEFORE stopping the ticker and
+        # closing the WAL: a message processed after either would schedule
+        # a fresh timer on a dead ticker (leaked task) or write to a closed
+        # WAL file.  Service.stop's generic cancel pass happens after
+        # on_stop, which is too late for that ordering.
+        for t in (self._receive_task, self._ticker_pump, self._txs_pump):
+            if t is not None and not t.done():
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
         await self.timeout_ticker.stop()
         self.wal.close()
 
@@ -501,6 +513,19 @@ class ConsensusState(Service):
             self.block_exec.validate_block(self.sm_state, rs.proposal_block)
         except Exception as e:
             self.log.error("prevote: ProposalBlock is invalid", err=str(e))
+            await self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+            return
+        # Timestamp sanity (reference state/validation.go block-time area,
+        # extended node-side): a proposal whose header time is beyond local
+        # now + drift would commit a block every light client rejects —
+        # refuse it here, at prevote, before it can gather a polka.
+        drift_ns = int(self.config.proposal_clock_drift * 1e9)
+        if drift_ns > 0 and rs.proposal_block.time_ns > time.time_ns() + drift_ns:
+            self.log.error(
+                "prevote: ProposalBlock time too far in the future",
+                block_time_ns=rs.proposal_block.time_ns,
+                drift_s=self.config.proposal_clock_drift,
+            )
             await self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
             return
         await self._sign_add_vote(

@@ -29,7 +29,14 @@ class TimeoutTicker(Service):
         self._current: Optional[TimeoutInfo] = None
 
     async def on_stop(self) -> None:
+        timer = self._timer_task
         self._stop_timer()
+        if timer is not None:
+            # reap the cancelled timer so it cannot outlive the service
+            try:
+                await timer
+            except asyncio.CancelledError:
+                pass
 
     def chan(self) -> asyncio.Queue:
         return self.tock
@@ -42,6 +49,8 @@ class TimeoutTicker(Service):
     def schedule_timeout(self, ti: TimeoutInfo) -> None:
         """Replace the pending timer iff ti is for a later H/R/S
         (ticker.go:94 timeoutRoutine semantics)."""
+        if self._stopped:
+            return  # a timer scheduled on a dead ticker would leak
         cur = self._current
         if cur is not None and self._timer_task is not None and not self._timer_task.done():
             if (ti.height, ti.round, ti.step) <= (cur.height, cur.round, cur.step):

@@ -25,8 +25,15 @@ _verifier: Optional[BatchVerifyFn] = None
 def host_batch_verify(
     pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ) -> List[bool]:
-    """Serial host fallback over the C ed25519 backend — the compatibility
-    baseline the TPU engine is benchmarked against."""
+    """Serial host fallback — the compatibility baseline the TPU engine is
+    benchmarked against.  Whole-batch C call when the extension is built
+    (one ctypes round trip instead of n), else per-key host verify."""
+    if len(sigs) > 1:
+        from . import hostprep
+
+        res = hostprep.host_verify_batch(pubkeys, msgs, sigs)
+        if res is not None:
+            return res
     from .keys import Ed25519PubKey
 
     out = []

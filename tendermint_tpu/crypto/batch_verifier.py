@@ -116,8 +116,18 @@ def _scalar_rows(
     """Shared per-signature host prep: SHA-512 h, scalar s, raw R limbs,
     canonical-S / length prefilters.  `items[i]` is (pubkey, msg, sig) or
     None when the caller already knows entry i is invalid.  Returns
-    (h_digits, s_digits, r_y_raw, r_sign, valid)."""
+    (h_digits, s_digits, r_y_raw, r_sign, valid).
+
+    Fast path: one fused, threaded C pass (hostprep.prep_scalar_rows)
+    straight from bytes to kernel-ready arrays — hash, mod-L reduce, digit
+    extraction, limb packing and the canonical-S prefilter never surface
+    as intermediate numpy arrays.  The numpy pipeline below remains as the
+    no-toolchain fallback and the differential-test reference."""
     from . import hostprep
+
+    fused = hostprep.prep_scalar_rows(items)
+    if fused is not None:
+        return fused
 
     n = len(items)
     valid = np.zeros(n, dtype=bool)
@@ -130,7 +140,7 @@ def _scalar_rows(
         if item is None:
             continue
         pk, msg, sig = item
-        if len(sig) != 64:
+        if len(sig) != 64 or len(pk) != 32:
             continue
         s_parts[i] = sig[32:]
         r_parts[i] = sig[:32]
@@ -194,6 +204,57 @@ def prepare_batch(
 _PALLAS_TILE = 512  # best-measured batch tile (sublane 20 x lane 512 blocks)
 _CHUNK = 2048  # double-buffer chunk for large single-shot indexed batches
 
+# Process-wide jit wrappers, shared across BatchVerifier/PubkeyTable
+# instances.  jax.jit memoizes traces per WRAPPER object: a per-instance
+# wrapper re-traces (and re-lowers) every bucket shape for every new
+# verifier — seconds per shape on a small host even when the persistent
+# compile cache hits, and tests/nodes create many verifiers.  Keyed by
+# (mesh, batch_axis): None for the single-device path.
+_shared_jit_lock = _threading.Lock()
+_shared_jit: Dict = {}
+
+
+def _shared_verify_jit(mesh, batch_axis: str):
+    key = (mesh, batch_axis) if mesh is not None else None
+    with _shared_jit_lock:
+        fn = _shared_jit.get(key)
+        if fn is None:
+            import jax
+
+            from ..ops import ed25519_kernel
+
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                data = NamedSharding(mesh, P(batch_axis))
+                fn = jax.jit(
+                    ed25519_kernel.verify_prepared,
+                    in_shardings=(data, data, data, data, data),
+                    out_shardings=data,
+                )
+            else:
+                fn = jax.jit(ed25519_kernel.verify_prepared)
+            _shared_jit[key] = fn
+    return fn
+
+
+def _shared_fused_jit(inner):
+    """Fused gather+verify wrapper, one per inner verify wrapper (which is
+    itself process-wide) — same per-instance re-trace trap as above."""
+    key = ("fused", id(inner))
+    with _shared_jit_lock:
+        fn = _shared_jit.get(key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            def run(rows, idx, h, s, ry, rs):
+                return inner(jnp.take(rows, idx, axis=0), h, s, ry, rs)
+
+            fn = jax.jit(run)
+            _shared_jit[key] = fn
+    return fn
+
 
 class BatchVerifier:
     """Batched ed25519 verification, jitted per bucket shape.
@@ -226,6 +287,63 @@ class BatchVerifier:
         self._compiling_buckets: set = set()
         self._failed_buckets: set = set()
         self._warm_lock = _threading.Lock()
+        # host<->device dispatch RTT probe (measured at install; drives the
+        # chunked-single-shot auto-selection).  None until probed.
+        self.rtt_probe: Optional[Dict[str, float]] = None
+
+    def probe_dispatch_rtt(self, samples: int = 7) -> Dict[str, float]:
+        """Measure what one extra device dispatch costs vs what one chunk
+        of host prep saves, and decide whether double-buffered chunking
+        pays (see PubkeyTable.chunked_single_shot).
+
+        - dispatch_rtt_ms: min round-trip of a minimal jitted dispatch +
+          result fetch.  Locally-attached devices: ~0.05-0.5 ms; tunnel-
+          attached TPUs: ~100 ms (measured r5) — there chunking loses.
+        - prep_ms_per_chunk: host prep time for one _CHUNK of signatures
+          (what overlap can hide per extra dispatch).
+
+        Chunking is selected iff dispatch_rtt_ms < prep_ms_per_chunk.
+        Cached after the first call."""
+        if self.rtt_probe is not None:
+            return self.rtt_probe
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        tiny = jax.jit(lambda x: x + 1)
+        x = jnp.zeros(8, jnp.int32)
+        tiny(x).block_until_ready()  # compile outside the timed loop
+        rtts = []
+        for _ in range(samples):
+            t0 = _time.perf_counter()
+            tiny(x).block_until_ready()
+            rtts.append(_time.perf_counter() - t0)
+        rtt_ms = min(rtts) * 1000
+        # host prep rate from a synthetic mini-batch (sign-bytes-sized msgs)
+        probe_n = 512
+        items = [
+            (bytes(32), b"\x08\x02\x11" + bytes(100), bytes(64))
+            for _ in range(probe_n)
+        ]
+        _scalar_rows(items)  # warm allocators / C lib load
+        t0 = _time.perf_counter()
+        _scalar_rows(items)
+        prep_per_sig_ms = (_time.perf_counter() - t0) * 1000 / probe_n
+        prep_ms_per_chunk = prep_per_sig_ms * _CHUNK
+        self.rtt_probe = {
+            "dispatch_rtt_ms": rtt_ms,
+            "prep_ms_per_chunk": prep_ms_per_chunk,
+            "chunked_selected": float(rtt_ms < prep_ms_per_chunk),
+        }
+        return self.rtt_probe
+
+    def chunked_auto(self) -> bool:
+        """True when the RTT probe says chunked single-shot overlap pays."""
+        try:
+            return bool(self.probe_dispatch_rtt()["chunked_selected"])
+        except Exception:
+            return False  # probe failure: keep the safe monolithic path
 
     def _compile_bucket(self, b: int) -> None:
         neg_a = np.zeros((b, 4, _N_LIMBS), dtype=np.int16)
@@ -292,27 +410,14 @@ class BatchVerifier:
 
     def _jitted_locked(self):
         if self._fn is None:
-            import jax
-
-            from ..ops import ed25519_kernel
-
-            if self.mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
-                data = NamedSharding(self.mesh, P(self.batch_axis))
-                self._fn = jax.jit(
-                    ed25519_kernel.verify_prepared,
-                    in_shardings=(data, data, data, data, data),
-                    out_shardings=data,
-                )
-            elif self._use_pallas():
+            if self._use_pallas():
                 import functools
 
                 from ..ops.ed25519_pallas import verify_prepared_pallas
 
                 self._fn = functools.partial(verify_prepared_pallas, tile=_PALLAS_TILE)
             else:
-                self._fn = jax.jit(ed25519_kernel.verify_prepared)
+                self._fn = _shared_verify_jit(self.mesh, self.batch_axis)
         return self._fn
 
     def _pad_multiple(self) -> int:
@@ -354,8 +459,13 @@ class BatchVerifier:
 
     def install(self) -> "BatchVerifier":
         """Become the process-wide batch-verify hook used by
-        ValidatorSet.verify_commit* and friends."""
+        ValidatorSet.verify_commit* and friends.  Kicks off the dispatch
+        RTT probe in the background so the chunked-single-shot decision is
+        ready (and reported) before the first large batch arrives."""
         batch_hook.set_verifier(self.verify)
+        _threading.Thread(
+            target=self.chunked_auto, daemon=False, name="bv-rtt-probe"
+        ).start()
         return self
 
 
@@ -409,8 +519,10 @@ class PubkeyTable:
         # a win on locally-attached devices (saves ~prep time), but each
         # extra dispatch pays the host<->device RTT, which on tunnel-attached
         # TPUs (~100 ms) dwarfs the saving (measured: 495 ms vs 153 ms
-        # single-dispatch for 10k).  Off by default; flip on local hosts.
-        self.chunked_single_shot = False
+        # single-dispatch for 10k).  None = auto: decided by the verifier's
+        # install-time RTT probe (chunked iff one dispatch RTT < one chunk
+        # of host prep).  True/False still force it either way.
+        self.chunked_single_shot: Optional[bool] = None
         self._window_tables = None
         self._interpret = False  # CPU-interpret pallas (tests only)
 
@@ -432,16 +544,17 @@ class PubkeyTable:
         with the verify kernel — a second dispatch would pay the host↔device
         round-trip latency twice (it is large on remote-attached TPUs)."""
         if self._fused_fn is None:
-            import jax
+            import jax.numpy as jnp
 
             inner = self.verifier._jitted()
+            if self.verifier.mesh is None:
+                self._fused_fn = _shared_fused_jit(inner)
+            else:
 
-            def run(rows, idx, h, s, ry, rs):
-                import jax.numpy as jnp
+                def run(rows, idx, h, s, ry, rs):
+                    return inner(jnp.take(rows, idx, axis=0), h, s, ry, rs)
 
-                return inner(jnp.take(rows, idx, axis=0), h, s, ry, rs)
-
-            self._fused_fn = jax.jit(run) if self.verifier.mesh is None else run
+                self._fused_fn = run
         return self._fused_fn
 
     def verify_indexed(
@@ -470,7 +583,10 @@ class PubkeyTable:
             if 0 <= idx < pk_count and self.row_valid[idx]:
                 items[i] = (self.pubkeys[idx], msg, sig)
 
-        if self.chunked_single_shot and not self.tabulated and n >= 2 * _CHUNK:
+        use_chunked = self.chunked_single_shot
+        if use_chunked is None and not self.tabulated and n >= 2 * _CHUNK:
+            use_chunked = self.verifier.chunked_auto()
+        if use_chunked and not self.tabulated and n >= 2 * _CHUNK:
             # Double-buffered single-shot: device dispatch is async, so
             # prepping chunk k+1 on the host while the device runs chunk k
             # hides most of the host prep inside device time — single-shot
@@ -648,10 +764,19 @@ class AsyncBatchVerifier(Service):
     """Deadline-flushed batcher (SURVEY.md §7 inversion #1).
 
     Callers enqueue single (pubkey, msg, sig) checks and await a future;
-    a flusher drains the queue every `flush_interval` seconds (or
-    immediately at `max_batch`) into one BatchVerifier call.  Consensus
-    vote-add latency stays ~the flush interval while throughput scales with
-    batch size — the latency/batching tension called out in SURVEY.md §7.
+    a flusher coalesces the queue into one BatchVerifier call.  Consensus
+    vote-add latency stays ~the coalescing window while throughput scales
+    with batch size — the latency/batching tension called out in SURVEY.md
+    §7.
+
+    The window is ADAPTIVE to arrival rate (the fixed 2 ms quantum was a
+    measured drag on small nets: a 4-validator round has ~2 vote hops per
+    block and each paid the full quantum for a batch of one).  The flusher
+    waits in "quiet windows": when recent inter-arrival gaps say more votes
+    are imminent (storm or 100-val trickle) it keeps coalescing up to
+    `flush_interval`; when the queue goes quiet it flushes after
+    `flush_min` — sparse traffic pays ~flush_min, not the full quantum.
+    `adaptive=False` restores the fixed-interval behavior.
     """
 
     def __init__(
@@ -660,16 +785,24 @@ class AsyncBatchVerifier(Service):
         max_batch: int = 4096,
         flush_interval: float = 0.002,
         max_pending: int = 65536,
+        flush_min: float = 0.0002,
+        adaptive: bool = True,
     ):
         super().__init__("batch-verifier")
         self.verifier = verifier or BatchVerifier()
         self.max_batch = max_batch
         self.flush_interval = flush_interval
+        self.flush_min = min(flush_min, flush_interval)
+        self.adaptive = adaptive
         self.max_pending = max_pending
         self._pending: List[Tuple[bytes, bytes, bytes, asyncio.Future]] = []
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._executor = None
+        # EWMA of enqueue inter-arrival gap (seconds); None until 2 arrivals
+        self._ewma_gap: Optional[float] = None
+        self._last_arrival: Optional[float] = None
+        self._enqueued = 0  # monotonic count, detects arrivals per window
 
     async def on_start(self) -> None:
         from concurrent.futures import ThreadPoolExecutor
@@ -699,7 +832,8 @@ class AsyncBatchVerifier(Service):
             self._executor.shutdown(wait=False)
 
     def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> "asyncio.Future[bool]":
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
         if len(self._pending) >= self.max_pending:
             # Backpressure: beyond the cap, verify inline on the host path.
             # Slower per-sig, but bounded memory and no dropped-vote false
@@ -707,19 +841,65 @@ class AsyncBatchVerifier(Service):
             ok = batch_hook.host_batch_verify([pubkey], [msg], [sig])[0]
             fut.set_result(bool(ok))
             return fut
+        now = loop.time()
+        if self._last_arrival is not None:
+            gap = now - self._last_arrival
+            # one-sided clamp keeps a single long idle period (heights with
+            # no votes) from poisoning the estimate for the next burst
+            gap = min(gap, self.flush_interval)
+            self._ewma_gap = (
+                gap if self._ewma_gap is None else 0.8 * self._ewma_gap + 0.2 * gap
+            )
+        self._last_arrival = now
+        self._enqueued += 1
         self._pending.append((pubkey, msg, sig, fut))
-        if len(self._pending) >= self.max_batch and self._wake:
+        if self._wake and (self.adaptive or len(self._pending) >= self.max_batch):
             self._wake.set()
         return fut
+
+    def _quiet_window(self) -> float:
+        """How long the flusher waits for MORE arrivals before flushing.
+        Large when recent gaps say votes are streaming in (coalesce them),
+        floor when the expected next arrival is beyond the deadline anyway
+        (waiting buys nothing but latency)."""
+        gap = self._ewma_gap
+        if gap is None or 4 * gap >= self.flush_interval:
+            return self.flush_min
+        return max(4 * gap, self.flush_min)
+
+    async def _wait_for_batch(self) -> None:
+        """Adaptive coalescing: sleep until there is work, then extend in
+        quiet windows while arrivals continue, capped at flush_interval."""
+        loop = asyncio.get_event_loop()
+        if not self._pending:
+            await self._wake.wait()
+            self._wake.clear()
+        deadline = loop.time() + self.flush_interval
+        while self._pending and len(self._pending) < self.max_batch:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            before = self._enqueued
+            try:
+                await asyncio.wait_for(
+                    self._wake.wait(), timeout=min(self._quiet_window(), remaining)
+                )
+            except asyncio.TimeoutError:
+                if self._enqueued == before:
+                    break  # a full quiet window with no arrivals: flush now
+            self._wake.clear()
 
     async def _flush_loop(self) -> None:
         loop = asyncio.get_event_loop()
         while True:
-            try:
-                await asyncio.wait_for(self._wake.wait(), timeout=self.flush_interval)
-            except asyncio.TimeoutError:
-                pass
-            self._wake.clear()
+            if self.adaptive:
+                await self._wait_for_batch()
+            else:
+                try:
+                    await asyncio.wait_for(self._wake.wait(), timeout=self.flush_interval)
+                except asyncio.TimeoutError:
+                    pass
+                self._wake.clear()
             if not self._pending:
                 continue
             # chunk at max_batch so one storm doesn't produce an unbounded

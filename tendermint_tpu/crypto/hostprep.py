@@ -8,10 +8,16 @@ kernel's amortized time.  This module provides:
 - a batch SHA-512 C extension (csrc/sha512_batch.c), compiled on demand
   with the system toolchain and loaded via ctypes (no Python.h / pybind11
   dependency), with a hashlib fallback when no compiler is present;
-- numpy-vectorized R-limb packing and canonical-S checks that replace
-  per-item Python loops.
+- a fused one-pass `prep_scalar_rows`: hash + Barrett mod-L + 4-bit digit
+  extraction + 13-bit R-limb packing + canonical-S prefilter all emitted
+  kernel-ready from a single threaded C loop (no intermediate numpy
+  arrays) — the host-prep side of the verify hot path;
+- numpy-vectorized R-limb packing and canonical-S checks as the
+  no-toolchain fallback for the same outputs.
 
-Together: ~40 ms -> ~8 ms for a 10k batch (measured v5e host).
+Measured (2-core CI host): 10k-signature prep ~31 ms numpy-pieced ->
+~8-10 ms fused C (buffer assembly included), below the device kernel's
+steady-state time, so prep no longer co-bottlenecks the pipeline.
 """
 
 from __future__ import annotations
@@ -56,28 +62,136 @@ def _load_lib() -> Optional[ctypes.CDLL]:
         if not os.path.exists(so):
             fd, tmp = tempfile.mkstemp(suffix=".so", dir=_csrc_path())
             os.close(fd)
-            subprocess.run(
-                ["cc", "-O3", "-shared", "-fPIC", "-o", tmp, src],
-                check=True,
-                capture_output=True,
-                timeout=60,
-            )
+            base = ["cc", "-O3", "-shared", "-fPIC", "-pthread", "-o", tmp, src]
+            # -march=native buys ~20% on the SHA-512 compression loop; fall
+            # back for toolchains that reject it.  The artifact is per-host
+            # (hash-named, never committed), so native codegen is safe.
+            try:
+                subprocess.run(
+                    base[:2] + ["-march=native"] + base[2:],
+                    check=True, capture_output=True, timeout=60,
+                )
+            except Exception:
+                subprocess.run(base, check=True, capture_output=True, timeout=60)
             os.replace(tmp, so)
         lib = ctypes.CDLL(so)
-        argtypes = [
-            ctypes.c_char_p,
-            np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
-            ctypes.c_uint64,
-            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
-        ]
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+        i16p = np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS")
+        argtypes = [ctypes.c_char_p, u64p, ctypes.c_uint64, u8p]
         lib.sha512_batch.argtypes = argtypes
         lib.sha512_batch.restype = None
         lib.sha512_mod_l_batch.argtypes = argtypes
         lib.sha512_mod_l_batch.restype = None
+        # one-pass kernel-ready prep (threaded)
+        lib.ed25519_prep_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, u64p, u8p,
+            ctypes.c_uint64, u8p, u8p, i16p, u8p, u8p, ctypes.c_int,
+        ]
+        lib.ed25519_prep_batch.restype = None
+        # serial host path (crypto.backend tier 2)
+        lib.ed25519_verify.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p
+        ]
+        lib.ed25519_verify.restype = ctypes.c_int
+        lib.ed25519_verify_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, u64p, ctypes.c_char_p,
+            ctypes.c_uint64, u8p,
+        ]
+        lib.ed25519_verify_batch.restype = None
+        lib.chacha20poly1305_open.restype = ctypes.c_int
         _lib = lib
     except Exception:
         _lib = None
     return _lib
+
+
+_PREP_THREADS = min(os.cpu_count() or 1, 8)
+
+
+def have_fast_prep() -> bool:
+    return _load_lib() is not None
+
+
+def prep_scalar_rows(items) -> Optional[tuple]:
+    """One C pass from raw (pubkey, msg, sig) triples to kernel-ready
+    arrays: (h_digits [n,64] u8, s_digits [n,64] u8, r_y [n,20] i16,
+    r_sign [n] u8, valid [n] bool).  `items[i]` is a triple or None for
+    entries the caller already knows are invalid (emitted as zeros).
+    Returns None when the C extension is unavailable (caller falls back
+    to the numpy path)."""
+    lib = _load_lib()
+    if lib is None:
+        return None
+    n = len(items)
+    zeros64 = bytes(64)
+    zeros32 = bytes(32)
+    empty = b""
+    sig_parts: list = [zeros64] * n
+    pk_parts: list = [zeros32] * n
+    msg_parts: list = [empty] * n
+    skip = np.ones(n, dtype=np.uint8)
+    lens = np.zeros(n, dtype=np.uint64)
+    for i, item in enumerate(items):
+        if item is None:
+            continue
+        pk, msg, sig = item
+        if len(sig) != 64 or len(pk) != 32:
+            continue
+        sig_parts[i] = sig
+        pk_parts[i] = pk
+        msg_parts[i] = msg
+        lens[i] = len(msg)
+        skip[i] = 0
+    offs = np.zeros(n + 1, dtype=np.uint64)
+    np.cumsum(lens, out=offs[1:])
+    h_digits = np.empty((n, 64), dtype=np.uint8)
+    s_digits = np.empty((n, 64), dtype=np.uint8)
+    r_y = np.empty((n, 20), dtype=np.int16)
+    r_sign = np.empty(n, dtype=np.uint8)
+    valid = np.empty(n, dtype=np.uint8)
+    lib.ed25519_prep_batch(
+        b"".join(sig_parts), b"".join(pk_parts), b"".join(msg_parts),
+        offs, skip, n, h_digits, s_digits, r_y, r_sign, valid,
+        _PREP_THREADS,
+    )
+    return h_digits, s_digits, r_y, r_sign, valid.astype(bool)
+
+
+def host_verify_batch(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> Optional[List[bool]]:
+    """Serial C host verify for a whole batch (one ctypes call instead of
+    n).  None when the C extension is unavailable."""
+    lib = _load_lib()
+    if lib is None:
+        return None
+    n = len(sigs)
+    zeros64 = bytes(64)
+    zeros32 = bytes(32)
+    sig_parts: list = [zeros64] * n
+    pk_parts: list = [zeros32] * n
+    msg_parts: list = [b""] * n
+    bad = []
+    lens = np.zeros(n, dtype=np.uint64)
+    for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
+        if len(pk) != 32 or len(sig) != 64:
+            bad.append(i)
+            continue
+        pk_parts[i] = pk
+        sig_parts[i] = sig
+        msg_parts[i] = msg
+        lens[i] = len(msg)
+    offs = np.zeros(n + 1, dtype=np.uint64)
+    np.cumsum(lens, out=offs[1:])
+    out = np.empty(n, dtype=np.uint8)
+    lib.ed25519_verify_batch(
+        b"".join(pk_parts), b"".join(msg_parts), offs, b"".join(sig_parts), n, out
+    )
+    res = out.astype(bool)
+    for i in bad:
+        res[i] = False
+    return res.tolist()
 
 
 def sha512_mod_l(parts: Sequence[bytes]) -> np.ndarray:

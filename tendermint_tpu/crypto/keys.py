@@ -5,8 +5,9 @@ ed25519 keys (crypto/ed25519/ed25519.go; address = SHA256(pubkey)[:20],
 ed25519.go:138), secp256k1 keys (crypto/secp256k1/; address =
 RIPEMD160(SHA256(pubkey))).
 
-Host signing/verifying uses the `cryptography` library's C backends; the
-pure-Python math in `ed25519_math` is the differential-test oracle and the
+Host signing/verifying routes through `crypto.backend` (cryptography's
+C backends when importable, else the project's own C extension, else pure
+Python); `ed25519_math` is the differential-test oracle and the
 decompression path for the TPU pubkey table.  Batched verification lives in
 `crypto/batch_verifier.py`.
 """
@@ -16,21 +17,9 @@ from __future__ import annotations
 import hashlib
 import os
 from abc import ABC, abstractmethod
-from typing import Optional
-
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature,
-    encode_dss_signature,
-)
 
 from ..encoding.codec import register
+from . import backend
 from . import ed25519_math
 from .tmhash import sum_truncated
 
@@ -97,7 +86,6 @@ class Ed25519PubKey(PubKey):
         if len(data) != self.SIZE:
             raise ValueError(f"ed25519 pubkey must be {self.SIZE} bytes")
         self._data = bytes(data)
-        self._handle: Optional[Ed25519PublicKey] = None
 
     def address(self) -> bytes:
         # reference crypto/ed25519/ed25519.go:138 — SHA256 truncated to 20B
@@ -114,17 +102,11 @@ class Ed25519PubKey(PubKey):
         """
         if len(sig) != self.SIG_SIZE:
             return False
-        # Match x/crypto semantics: reject non-canonical S explicitly (the
-        # cryptography lib also rejects, but keep the check locked in).
+        # Match x/crypto semantics: reject non-canonical S explicitly
+        # (backends also reject, but keep the check locked in).
         if not ed25519_math.sc_minimal(sig[32:]):
             return False
-        try:
-            if self._handle is None:
-                self._handle = Ed25519PublicKey.from_public_bytes(self._data)
-            self._handle.verify(sig, msg)
-            return True
-        except (InvalidSignature, ValueError):
-            return False
+        return backend.ed25519_verify(self._data, msg, sig)
 
     @classmethod
     def from_dict(cls, d: dict) -> "Ed25519PubKey":
@@ -142,12 +124,7 @@ class Ed25519PrivKey(PrivKey):
         if len(seed) != self.SIZE:
             raise ValueError("ed25519 privkey must be a 32-byte seed")
         self._seed = bytes(seed)
-        self._handle = Ed25519PrivateKey.from_private_bytes(self._seed)
-        self._pub = Ed25519PubKey(
-            self._handle.public_key().public_bytes(
-                serialization.Encoding.Raw, serialization.PublicFormat.Raw
-            )
-        )
+        self._pub = Ed25519PubKey(backend.ed25519_pub_from_seed(self._seed))
 
     @classmethod
     def generate(cls) -> "Ed25519PrivKey":
@@ -163,7 +140,7 @@ class Ed25519PrivKey(PrivKey):
         return self._seed
 
     def sign(self, msg: bytes) -> bytes:
-        return self._handle.sign(msg)
+        return backend.ed25519_sign(self._seed, self._pub.bytes(), msg)
 
     def pub_key(self) -> Ed25519PubKey:
         return self._pub
@@ -194,7 +171,6 @@ class Secp256k1PubKey(PubKey):
         if len(data) != self.SIZE:
             raise ValueError(f"secp256k1 pubkey must be {self.SIZE} bytes")
         self._data = bytes(data)
-        self._handle: Optional[ec.EllipticCurvePublicKey] = None
 
     def address(self) -> bytes:
         sha = hashlib.sha256(self._data).digest()
@@ -210,16 +186,7 @@ class Secp256k1PubKey(PubKey):
         s = int.from_bytes(sig[32:], "big")
         if s > _SECP_N // 2:  # reject malleable high-S, parity with reference
             return False
-        try:
-            if self._handle is None:
-                self._handle = ec.EllipticCurvePublicKey.from_encoded_point(
-                    ec.SECP256K1(), self._data
-                )
-            der = encode_dss_signature(r, s)
-            self._handle.verify(der, msg, ec.ECDSA(hashes.SHA256()))
-            return True
-        except (InvalidSignature, ValueError):
-            return False
+        return backend.ecdsa_verify(self._data, msg, r, s)
 
     @classmethod
     def from_dict(cls, d: dict) -> "Secp256k1PubKey":
@@ -235,27 +202,17 @@ class Secp256k1PrivKey(PrivKey):
         if len(data) != self.SIZE:
             raise ValueError("secp256k1 privkey must be 32 bytes")
         self._data = bytes(data)
-        self._handle = ec.derive_private_key(
-            int.from_bytes(self._data, "big"), ec.SECP256K1()
-        )
-        pub = self._handle.public_key().public_bytes(
-            serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
-        )
-        self._pub = Secp256k1PubKey(pub)
+        self._pub = Secp256k1PubKey(backend.ecdsa_pub_from_priv(self._data))
 
     @classmethod
     def generate(cls) -> "Secp256k1PrivKey":
-        k = ec.generate_private_key(ec.SECP256K1())
-        return cls(k.private_numbers().private_value.to_bytes(32, "big"))
+        return cls(backend.ecdsa_generate())
 
     def bytes(self) -> bytes:
         return self._data
 
     def sign(self, msg: bytes) -> bytes:
-        der = self._handle.sign(msg, ec.ECDSA(hashes.SHA256()))
-        r, s = decode_dss_signature(der)
-        if s > _SECP_N // 2:  # normalize to lower-S
-            s = _SECP_N - s
+        r, s = backend.ecdsa_sign(self._data, msg)  # low-S normalized
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
     def pub_key(self) -> Secp256k1PubKey:

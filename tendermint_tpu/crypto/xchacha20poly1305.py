@@ -5,15 +5,16 @@ nonce AEAD the reference keeps for symmetric encryption needs.  Built as
 the standard construction: HChaCha20(key, nonce[:16]) derives a subkey,
 then IETF ChaCha20-Poly1305 runs with nonce 0x00000000 ‖ nonce[16:24].
 HChaCha20 is implemented from the ChaCha20 quarter-round directly
-(draft-irtf-cfrg-xchacha-03); the inner AEAD is the audited library
-primitive.  Test vectors from the draft in tests/test_crypto.py.
+(draft-irtf-cfrg-xchacha-03); the inner AEAD comes from `crypto.backend`
+(library primitive when available).  Test vectors from the draft in
+tests/test_crypto.py.
 """
 
 from __future__ import annotations
 
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from . import backend
 
 KEY_SIZE = 32
 NONCE_SIZE = 24
@@ -65,12 +66,12 @@ class XChaCha20Poly1305:
         if len(nonce) != NONCE_SIZE:
             raise ValueError(f"nonce must be {NONCE_SIZE} bytes")
         subkey = hchacha20(self._key, nonce[:16])
-        return ChaCha20Poly1305(subkey), b"\x00" * 4 + nonce[16:]
+        return subkey, b"\x00" * 4 + nonce[16:]
 
     def seal(self, nonce: bytes, plaintext: bytes, aad: bytes = b"") -> bytes:
-        aead, n12 = self._inner(nonce)
-        return aead.encrypt(n12, plaintext, aad or None)
+        subkey, n12 = self._inner(nonce)
+        return backend.chacha20poly1305_seal(subkey, n12, plaintext, aad)
 
     def open(self, nonce: bytes, ciphertext: bytes, aad: bytes = b"") -> bytes:
-        aead, n12 = self._inner(nonce)
-        return aead.decrypt(n12, ciphertext, aad or None)
+        subkey, n12 = self._inner(nonce)
+        return backend.chacha20poly1305_open(subkey, n12, ciphertext, aad)

@@ -71,6 +71,12 @@ class Service:
 
     async def stop(self) -> None:
         if self._stopped:
+            # A concurrent stop is (or was) in flight — wait for it so the
+            # caller's "await svc.stop()" means the service really finished
+            # (the error path stops a peer from a switch task while
+            # switch.on_stop stops the same peer; returning early here
+            # leaked the first stop's tasks past test teardown).
+            await self.wait_stopped()
             return
         self._stopped = True
         self.logger.debug("service stopping")
@@ -79,9 +85,17 @@ class Service:
         except asyncio.TimeoutError:
             self.logger.error("on_stop timed out after %.0fs; forcing", self.STOP_TIMEOUT)
         finally:
+            # Never cancel/await the task this stop() is running inside
+            # (a service stopping itself from one of its own tasks — e.g.
+            # a recv routine erroring out — must not strangle its own
+            # unwind, and awaiting yourself never completes).
+            current = asyncio.current_task()
             for t in self._tasks:
-                t.cancel()
+                if t is not current:
+                    t.cancel()
             for t in list(self._tasks):
+                if t is current:
+                    continue
                 try:
                     await asyncio.wait_for(t, self.STOP_TIMEOUT)
                 except (asyncio.CancelledError, asyncio.TimeoutError, Exception):
@@ -97,6 +111,12 @@ class Service:
         goroutines + WaitGroups.
         """
         task = asyncio.get_event_loop().create_task(coro, name=name or self._name)
+        if self._stopped:
+            # Stop already ran (or is running) its cancel pass — a task
+            # spawned now would never be cancelled and would outlive the
+            # service (e.g. a peer-error reconnect scheduled mid-teardown).
+            task.cancel()
+            return task
         self._tasks.append(task)
         task.add_done_callback(self._on_task_done)
         return task
@@ -110,7 +130,9 @@ class Service:
             return
         exc = task.exception()
         if exc is not None and not self._stopped:
-            self.logger.error("task %s crashed: %r", task.get_name(), exc)
+            self.logger.error(
+                "task %s crashed: %r", task.get_name(), exc, exc_info=exc
+            )
 
     async def wait_stopped(self) -> None:
         if self._quit is not None:

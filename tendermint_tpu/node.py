@@ -132,6 +132,8 @@ class Node(Service):
                 self.batch_verifier,
                 max_batch=cfg.tpu.max_batch,
                 flush_interval=cfg.tpu.flush_interval,
+                flush_min=cfg.tpu.flush_min,
+                adaptive=cfg.tpu.flush_adaptive,
             )
             await self.async_verifier.start()
         # remote signer: wait for the external signer to dial in BEFORE

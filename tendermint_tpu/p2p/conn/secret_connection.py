@@ -18,15 +18,7 @@ import hashlib
 import struct
 from typing import Optional, Tuple
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives.serialization import Encoding, PublicFormat
-
+from ...crypto import backend
 from ...crypto.keys import Ed25519PrivKey, Ed25519PubKey
 
 DATA_LEN_SIZE = 2
@@ -43,12 +35,9 @@ class SecretConnectionError(Exception):
 def _derive_secrets(shared: bytes, loc_is_least: bool) -> Tuple[bytes, bytes, bytes]:
     """HKDF expand to (recv_key, send_key, challenge) from our perspective
     (secret_connection.go deriveSecretAndChallenge)."""
-    okm = HKDF(
-        algorithm=hashes.SHA256(),
-        length=96,
-        salt=None,
-        info=b"TENDERMINT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN",
-    ).derive(shared)
+    okm = backend.hkdf_sha256(
+        shared, 96, b"TENDERMINT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
+    )
     if loc_is_least:
         recv_key, send_key = okm[0:32], okm[32:64]
     else:
@@ -84,8 +73,8 @@ class SecretConnection:
     ):
         self._reader = reader
         self._writer = writer
-        self._send_aead = ChaCha20Poly1305(send_key)
-        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_key = send_key
+        self._recv_key = recv_key
         self._send_nonce = _NonceCounter()
         self._recv_nonce = _NonceCounter()
         self.remote_pubkey = remote_pubkey
@@ -102,8 +91,7 @@ class SecretConnection:
         priv_key: Ed25519PrivKey,
     ) -> "SecretConnection":
         """secret_connection.go:87 MakeSecretConnection."""
-        eph_priv = X25519PrivateKey.generate()
-        eph_pub = eph_priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+        eph_priv, eph_pub = backend.x25519_generate()
 
         # 1. exchange ephemeral pubkeys (plaintext)
         writer.write(eph_pub)
@@ -111,7 +99,7 @@ class SecretConnection:
         remote_eph_pub = await reader.readexactly(32)
 
         # 2. shared secret + key derivation; key order by sorted eph keys
-        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph_pub))
+        shared = backend.x25519_shared(eph_priv, remote_eph_pub)
         loc_is_least = eph_pub < remote_eph_pub
         recv_key, send_key, challenge = _derive_secrets(shared, loc_is_least)
 
@@ -138,7 +126,9 @@ class SecretConnection:
                 chunk = data[off : off + DATA_MAX_SIZE]
                 frame = struct.pack("<H", len(chunk)) + chunk
                 frame += b"\x00" * (TOTAL_FRAME_SIZE - len(frame))
-                sealed = self._send_aead.encrypt(self._send_nonce.next(), frame, None)
+                sealed = backend.chacha20poly1305_seal(
+                    self._send_key, self._send_nonce.next(), frame
+                )
                 self._writer.write(sealed)
             await self._writer.drain()
 
@@ -148,7 +138,9 @@ class SecretConnection:
             while len(self._recv_buf) < n:
                 sealed = await self._reader.readexactly(SEALED_FRAME_SIZE)
                 try:
-                    frame = self._recv_aead.decrypt(self._recv_nonce.next(), sealed, None)
+                    frame = backend.chacha20poly1305_open(
+                        self._recv_key, self._recv_nonce.next(), sealed
+                    )
                 except Exception as e:
                     raise SecretConnectionError(f"frame decryption failed: {e}") from e
                 (length,) = struct.unpack_from("<H", frame)

@@ -8,11 +8,21 @@ a byte-level drop desyncs the AEAD stream, and under MConnection a
 packet-level drop corrupts multi-packet message reassembly — both turn
 "loss" into instant connection death, which tests reconnect but not
 protocol liveness under loss.  Here the fuzz sits at the CHANNEL MESSAGE
-boundary: whole gossip messages are dropped or delayed, framing stays
-intact, and the consensus/mempool/evidence reactors must survive real
-message loss by retransmission — the property the soak is after.
-(Connection churn itself is covered separately: dropped-link reconnect is
-exercised by the crash/recovery suite.)
+boundary: whole gossip messages are refused or delayed, framing stays
+intact, and the consensus/mempool/evidence reactors must survive the loss
+by retransmission — the property the soak is after.  (Connection churn
+itself is covered separately: dropped-link reconnect is exercised by the
+crash/recovery suite.)
+
+A dropped send REPORTS FAILURE (returns False) instead of silently
+swallowing the message: tendermint gossip runs over TCP, so its peer-state
+bookkeeping assumes sent == will-be-delivered unless the connection dies.
+A silent drop that still reports success plants a phantom "peer has this
+part/vote" bit; votes have a repair channel (VoteSetMaj23/VoteSetBits
+resync) but block-part bitmaps deliberately have none, so one phantom part
+can wedge a catching-up peer forever — a failure mode the real transport
+cannot produce.  Reporting failure models a transient send refusal, which
+every gossip loop already handles by re-picking and retrying.
 """
 
 from __future__ import annotations
@@ -48,7 +58,7 @@ class PeerFuzz:
             await self._maybe_delay()
             if self.rng.random() < self.prob_drop_rw:
                 self.dropped_sends += 1
-                return True  # swallowed: lost on the wire
+                return False  # refused: sender knows it was not delivered
             return await orig_send(chan_id, msg)
 
         peer.send = fuzzed_send
@@ -56,8 +66,8 @@ class PeerFuzz:
         return self
 
     def drop_recv(self) -> bool:
-        """True when an inbound message should be dropped."""
-        if self.rng.random() < self.prob_drop_rw:
-            self.dropped_recvs += 1
-            return True
+        """Inbound drops are disabled: discarding a message the remote has
+        already accounted as delivered fabricates the phantom-delivery
+        state TCP can never produce (see module docstring) — all loss is
+        injected on the send side, where it is honestly reportable."""
         return False

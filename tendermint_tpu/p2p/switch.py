@@ -233,10 +233,39 @@ class Switch(Service):
         await self.stop_peer_for_error(peer, str(err))
 
     async def stop_peer_for_error(self, peer: Peer, reason: str) -> None:
-        """switch.go:323 + persistent reconnect :376."""
+        """switch.go:323 + persistent reconnect :376.
+
+        When invoked from inside one of the peer's own connection tasks
+        (recv delivering the offending message, ping noticing the error),
+        the stop is detached onto a switch task: stopping inline would have
+        mconn.stop() await the cancellation of the very task this call
+        chain is suspended in — a cycle only the 10 s stop timeout breaks,
+        parking a half-stopped peer past test/node teardown."""
         if peer.id not in self.peers:
             return
         self.log.info("stopping peer for error", peer=peer.id[:12], err=reason)
+        if asyncio.current_task() in peer.mconn._tasks:
+            if self._stopped:
+                # Switch teardown in progress: spawn() would refuse (its
+                # cancel pass already ran) and the peer would end up popped
+                # but never stopped.  Leave it in the table — on_stop's
+                # sweep stops every listed peer from the stop task, where
+                # inline stopping is safe.
+                return
+            # The peer stays in self.peers until _stop_and_remove_peer
+            # pops it, so a not-yet-run task is still covered by the
+            # on_stop sweep if the switch stops first.
+            self.spawn(
+                self._finish_stop_peer(peer, reason), f"peer-err-{peer.id[:8]}"
+            )
+            return
+        await self._stop_and_remove_peer(peer, reason)
+        if peer.persistent:
+            self._maybe_reconnect(peer.id)
+
+    async def _finish_stop_peer(self, peer: Peer, reason: str) -> None:
+        if peer.id not in self.peers:
+            return  # a second conn-task error already detached a stop
         await self._stop_and_remove_peer(peer, reason)
         if peer.persistent:
             self._maybe_reconnect(peer.id)

@@ -198,7 +198,15 @@ class BlockExecutor:
         """state/execution.go:314 getBeginBlockValidatorInfo."""
         votes = []
         if block.height > 1:
-            last_val_set = self.state_store.load_validators(block.height - 1)
+            if block.height - 1 == state.last_block_height:
+                # Live path: the set is already in hand.  The store load
+                # fast-forwards proposer priority by (height − last_changed)
+                # — O(height) per block with a static validator set, i.e.
+                # O(height²) over a run — and LastCommitInfo only reads
+                # address/power/absence, which priorities never affect.
+                last_val_set = state.last_validators
+            else:
+                last_val_set = self.state_store.load_validators(block.height - 1)
             if last_val_set is None:
                 last_val_set = state.last_validators
             if block.last_commit.size() != last_val_set.size():

@@ -66,8 +66,15 @@ class StateStore:
         return state
 
     # -- historical validator sets ----------------------------------------
+    # Full-set checkpoint cadence for unchanged validator sets (reference
+    # valSetCheckpointInterval, state/store.go:42, shrunk for Python):
+    # load_validators replays proposer priority once per height since the
+    # last full record, so a pointer chain growing with chain height makes
+    # historical loads O(height) each.  A checkpoint bounds the replay.
+    VALSET_CHECKPOINT_INTERVAL = 1024
+
     def _save_validators(self, height: int, last_changed: int, vals: ValidatorSet) -> None:
-        if height == last_changed:
+        if height == last_changed or height % self.VALSET_CHECKPOINT_INTERVAL == 0:
             payload = {"last_changed": last_changed, "validators": vals.to_dict()}
         else:
             # pointer record only — the full set lives at last_changed
@@ -75,19 +82,30 @@ class StateStore:
         self.db.set(_k_validators(height), codec.dumps(payload))
 
     def load_validators(self, height: int) -> Optional[ValidatorSet]:
-        """LoadValidators (state/store.go:295): follow the pointer, then
-        fast-forward proposer priority by the height delta."""
+        """LoadValidators (state/store.go:295): follow the pointer to the
+        nearest full record — the last set change or a later checkpoint —
+        then fast-forward proposer priority by the remaining delta."""
         d = self._load_validators_info(height)
         if d is None:
             return None
         if d["validators"] is None:
             last_changed = d["last_changed"]
-            d2 = self._load_validators_info(last_changed)
+            stored = max(
+                last_changed,
+                (height // self.VALSET_CHECKPOINT_INTERVAL)
+                * self.VALSET_CHECKPOINT_INTERVAL,
+            )
+            d2 = self._load_validators_info(stored)
+            if d2 is None or d2["validators"] is None:
+                # no checkpoint at that height (e.g. records written before
+                # checkpointing existed): fall back to the change record
+                stored = last_changed
+                d2 = self._load_validators_info(stored)
             if d2 is None or d2["validators"] is None:
                 return None
             vals = ValidatorSet.from_dict(d2["validators"])
-            if height > last_changed:
-                vals.increment_proposer_priority(height - last_changed)
+            if height > stored:
+                vals.increment_proposer_priority(height - stored)
             return vals
         return ValidatorSet.from_dict(d["validators"])
 

@@ -3,6 +3,11 @@
 Tests run JAX on a virtual 8-device CPU mesh so multi-chip sharding logic is
 exercised without TPU hardware (real-chip execution is covered by bench.py
 and the driver's dryrun).  Environment must be set before jax imports.
+
+Every coroutine test runs under a leak guard: a test that returns while
+asyncio tasks are still alive on its loop FAILS (the reference runs
+leaktest on every net test — long-lived stray tasks are exactly how the
+round-4 reactor-starvation bug class recurs).
 """
 
 import os
@@ -14,6 +19,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Persistent XLA compile cache: the ed25519 ladder kernels take minutes of
+# compile on a small CI host and are identical across test processes and
+# reruns; cache them once per machine.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_tendermint_tpu")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 import asyncio  # noqa: E402
 
@@ -33,6 +43,16 @@ def pytest_collection_modifyitems(config, items):
     pass
 
 
+def _drain_leaked_tasks(loop, leaked):
+    for t in leaked:
+        t.cancel()
+
+    async def _reap():
+        await asyncio.gather(*leaked, return_exceptions=True)
+
+    loop.run_until_complete(asyncio.wait_for(_reap(), timeout=10))
+
+
 def pytest_pyfunc_call(pyfuncitem):
     import inspect
 
@@ -45,6 +65,27 @@ def pytest_pyfunc_call(pyfuncitem):
         loop = asyncio.new_event_loop()
         try:
             loop.run_until_complete(asyncio.wait_for(func(**kwargs), timeout=120))
+            # leak guard: the test owns this loop, so anything still alive
+            # is an un-stopped service/server/background task.  Candidates
+            # get a short real drain first: a cancellation cascade mid-
+            # unwind (wait_for abandons the inner future on outer cancel,
+            # bpo semantics) finishes in a few cycles, while a genuinely
+            # un-stopped task survives the window and is flagged.
+            leaked = [t for t in asyncio.all_tasks(loop) if not t.done()]
+            if leaked:
+                loop.run_until_complete(asyncio.wait(leaked, timeout=0.25))
+                leaked = [t for t in leaked if not t.done()]
+            if leaked:
+                names = ", ".join(
+                    f"{t.get_name()}<{getattr(t.get_coro(), '__qualname__', t.get_coro())}>"
+                    for t in leaked
+                )
+                _drain_leaked_tasks(loop, leaked)
+                pytest.fail(
+                    f"leak guard: test left {len(leaked)} live asyncio task(s) "
+                    f"behind: {names}",
+                    pytrace=False,
+                )
         finally:
             loop.close()
         return True

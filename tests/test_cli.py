@@ -85,8 +85,10 @@ class TestTestnet:
         the generated config tree) and watch them commit together."""
         from tendermint_tpu.node import default_new_node
 
+        from tests.test_tools import _free_base_port
+
         out = str(tmp_path / "net")
-        run_cli("testnet", "-v", "4", "-o", out, "--base-port", "28700")
+        run_cli("testnet", "-v", "4", "-o", out, "--base-port", str(_free_base_port(4)))
         nodes = []
         try:
             for i in range(4):

@@ -24,6 +24,13 @@ from tendermint_tpu.proxy import default_client_creator
 from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
 from tendermint_tpu.types.events import EVENT_NEW_BLOCK, EventBus, query_for_event
 
+from tendermint_tpu.types.params import BlockParams as _BP, ConsensusParams as _CP
+
+# time_iota_ms=1: test chains commit ~10 blocks/sec (skip_timeout_commit), so the
+# reference's default 1000 ms BFT-time step would race header time ahead of wall
+# clock and trip clock-drift guards (lite2 + propose-side) under suite load
+_FAST_IOTA_PARAMS = _CP(block=_BP(time_iota_ms=1))
+
 CHAIN_ID = "cs-test-chain"
 
 
@@ -32,6 +39,7 @@ def make_genesis(pvs, power=10):
         chain_id=CHAIN_ID,
         genesis_time_ns=1_700_000_000_000_000_000,
         validators=[GenesisValidator(pv.address(), pv.get_pub_key(), power) for pv in pvs],
+        consensus_params=_FAST_IOTA_PARAMS,
     )
 
 
@@ -164,6 +172,59 @@ class TestSoloNode:
         node, pv = solo_node(tmp_path)
         assert only_validator_is_us(node.state, pv)
         assert not only_validator_is_us(node.state, MockPV())
+
+    async def test_future_block_time_gets_nil_prevote(self, tmp_path):
+        """Propose-side clock sanity (reference state/validation.go block
+        time checks, extended to prevote time): a proposal whose header
+        time is past local now + proposal_clock_drift must draw a nil
+        prevote — a committed far-future block would be rejected by every
+        light client — while a sane proposal commits normally."""
+        import dataclasses
+        import time
+
+        from tendermint_tpu.types.block import Block
+
+        node, pv = solo_node(tmp_path)
+        await node.start()
+        try:
+            await wait_blocks(node, 1)
+            cs = node.consensus
+            drift_ns = int(cs.config.proposal_clock_drift * 1e9)
+            assert drift_ns > 0  # guard enabled by default
+            orig_create = cs._create_proposal_block
+
+            def future_create():
+                created = orig_create()
+                if created is None:
+                    return None
+                block, _ = created
+                from tendermint_tpu.types.part_set import BLOCK_PART_SIZE_BYTES
+
+                bad = Block(
+                    dataclasses.replace(
+                        block.header, time_ns=time.time_ns() + 2 * drift_ns
+                    ),
+                    block.txs,
+                    block.evidence,
+                    block.last_commit,
+                )
+                return bad, bad.make_part_set(BLOCK_PART_SIZE_BYTES)
+
+            cs._create_proposal_block = future_create
+            await asyncio.sleep(0.3)  # drain proposals created pre-patch
+            stuck_h = node.block_store.height()
+            await asyncio.sleep(1.0)
+            # the solo validator nil-prevotes its own future-stamped blocks,
+            # so nothing can commit while the clock lies
+            assert node.block_store.height() == stuck_h
+            cs._create_proposal_block = orig_create
+            async def resumed():
+                while node.block_store.height() <= stuck_h:
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(resumed(), 20.0)
+        finally:
+            await node.stop()
 
 
 class TestCrashRestart:

@@ -15,6 +15,13 @@ from tendermint_tpu.node import Node
 from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
 from tendermint_tpu.types.events import EVENT_NEW_BLOCK, query_for_event
 
+from tendermint_tpu.types.params import BlockParams as _BP, ConsensusParams as _CP
+
+# time_iota_ms=1: test chains commit ~10 blocks/sec (skip_timeout_commit), so the
+# reference's default 1000 ms BFT-time step would race header time ahead of wall
+# clock and trip clock-drift guards (lite2 + propose-side) under suite load
+_FAST_IOTA_PARAMS = _CP(block=_BP(time_iota_ms=1))
+
 CHAIN_ID = "net-test-chain"
 
 
@@ -25,6 +32,7 @@ async def make_net(tmp_path, n, name="net"):
         chain_id=CHAIN_ID,
         genesis_time_ns=1_700_000_000_000_000_000,
         validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
+        consensus_params=_FAST_IOTA_PARAMS,
     )
     nodes = []
     for i, pv in enumerate(pvs):
@@ -111,10 +119,38 @@ class TestConsensusNet:
     async def test_node_catches_up_after_join(self, tmp_path):
         # start 3 of 4 validators; they have +2/3 (30 of 40) and progress.
         # The 4th joins late and must catch up via consensus catchup gossip.
+        from tendermint_tpu.privval.file import DoubleSignError
+
+        class _GuardedPV:
+            """The restarted validator with its persisted last-sign state:
+            a file-backed privval refuses to re-sign heights it signed
+            before the restart (FilePV.check_hrs) instead of double-signing
+            them — without this, the rejoining MockPV races catchup gossip
+            and can sign a conflicting height-1 vote, which correctly
+            halts it (state.go: conflicting vote from ourselves)."""
+
+            def __init__(self, inner, floor_height):
+                self._inner = inner
+                self._floor = floor_height
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def sign_vote(self, chain_id, vote):
+                if vote.height <= self._floor:
+                    raise DoubleSignError(f"already signed height {vote.height}")
+                self._inner.sign_vote(chain_id, vote)
+
+            def sign_proposal(self, chain_id, proposal):
+                if proposal.height <= self._floor:
+                    raise DoubleSignError(f"already signed height {proposal.height}")
+                self._inner.sign_proposal(chain_id, proposal)
+
         nodes, pvs = await make_net(tmp_path, 4)
         try:
             late = nodes[3]
             await late.stop()
+            signed_floor = late.block_store.height() + 1  # +1: in-flight round
             rest = nodes[:3]
             await wait_all_height(rest, 3)
 
@@ -130,8 +166,11 @@ class TestConsensusNet:
                 validators=[
                     GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs
                 ],
+                consensus_params=_FAST_IOTA_PARAMS,
             )
-            rejoin = Node(cfg, gen, priv_validator=pvs[3], db_backend="memdb")
+            rejoin = Node(
+                cfg, gen, priv_validator=_GuardedPV(pvs[3], signed_floor), db_backend="memdb"
+            )
             await rejoin.start()
             for peer_node in rest:
                 addr = f"{peer_node.node_key.id}@{peer_node.switch.transport.listen_addr}"

@@ -15,6 +15,13 @@ from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
 from tests.test_consensus_net import CHAIN_ID, make_net, stop_net, wait_all_height
 from tests.test_types import make_block_id, make_commit, rand_validator_set
 
+from tendermint_tpu.types.params import BlockParams as _BP, ConsensusParams as _CP
+
+# time_iota_ms=1: test chains commit ~10 blocks/sec (skip_timeout_commit), so the
+# reference's default 1000 ms BFT-time step would race header time ahead of wall
+# clock and trip clock-drift guards (lite2 + propose-side) under suite load
+_FAST_IOTA_PARAMS = _CP(block=_BP(time_iota_ms=1))
+
 
 class TestScheduler:
     def test_requests_spread_across_peers(self):
@@ -145,6 +152,7 @@ class TestFastSyncNet:
                 validators=[
                     GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs
                 ],
+                consensus_params=_FAST_IOTA_PARAMS,
             )
             syncer = Node(cfg, gen, priv_validator=None, db_backend="memdb")
             await syncer.start()

@@ -14,6 +14,13 @@ from tendermint_tpu.config import test_config as make_test_cfg
 from tendermint_tpu.node import Node
 from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
 
+from tendermint_tpu.types.params import BlockParams as _BP, ConsensusParams as _CP
+
+# time_iota_ms=1: test chains commit ~10 blocks/sec (skip_timeout_commit), so the
+# reference's default 1000 ms BFT-time step would race header time ahead of wall
+# clock and trip clock-drift guards (lite2 + propose-side) under suite load
+_FAST_IOTA_PARAMS = _CP(block=_BP(time_iota_ms=1))
+
 CHAIN_ID = "grpc-chain"
 
 
@@ -53,6 +60,7 @@ class TestABCIGRPC:
             chain_id=CHAIN_ID,
             genesis_time_ns=1_700_000_000_000_000_000,
             validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10)],
+            consensus_params=_FAST_IOTA_PARAMS,
         )
         cfg = make_test_cfg(str(tmp_path / "gnode"))
         cfg.rpc.laddr = ""
@@ -85,6 +93,7 @@ class TestBroadcastAPI:
             chain_id=CHAIN_ID,
             genesis_time_ns=1_700_000_000_000_000_000,
             validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10)],
+            consensus_params=_FAST_IOTA_PARAMS,
         )
         cfg = make_test_cfg(str(tmp_path / "bnode"))
         cfg.rpc.laddr = ""

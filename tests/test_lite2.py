@@ -263,11 +263,19 @@ class TestAgainstLiveNode:
         from tendermint_tpu.config import test_config as make_test_cfg
         from tendermint_tpu.node import Node
 
+        from tendermint_tpu.types.params import BlockParams, ConsensusParams
+
         pv = MockPV()
         gen = GenesisDoc(
             chain_id=CHAIN,
             genesis_time_ns=T0,
             validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10)],
+            # iota=1ms: this node commits ~10 blocks/sec (skip_timeout_commit),
+            # so the default 1000 ms BFT-time minimum step would race header
+            # time ~0.9 s/block ahead of wall clock — under suite load the
+            # light client then (correctly) rejects headers "from the future"
+            # past max_clock_drift.  The chain must not outrun the wall clock.
+            consensus_params=ConsensusParams(block=BlockParams(time_iota_ms=1)),
         )
         cfg = make_test_cfg(str(tmp_path / "lightnode"))
         cfg.base.db_backend = "memdb"
@@ -291,5 +299,46 @@ class TestAgainstLiveNode:
             sh = await c.update()
             assert sh is not None and sh.height >= 5
             await primary.close()
+        finally:
+            await node.stop()
+
+    async def test_fast_chain_headers_stay_within_clock_drift(self, tmp_path):
+        """Regression for the live-sync flake: a chain committing many
+        blocks per second must keep header time within lite2's
+        max_clock_drift of wall clock (time_iota_ms=1 genesis), no matter
+        how many blocks land before a light client syncs."""
+        import time as _time
+
+        from tendermint_tpu.config import test_config as make_test_cfg
+        from tendermint_tpu.lite2.client import _DEFAULT_MAX_CLOCK_DRIFT_NS
+        from tendermint_tpu.node import Node
+        from tendermint_tpu.types.params import BlockParams, ConsensusParams
+
+        pv = MockPV()
+        gen = GenesisDoc(
+            chain_id=CHAIN,
+            genesis_time_ns=_time.time_ns(),
+            validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10)],
+            consensus_params=ConsensusParams(block=BlockParams(time_iota_ms=1)),
+        )
+        cfg = make_test_cfg(str(tmp_path / "fastnode"))
+        cfg.base.db_backend = "memdb"
+        node = Node(cfg, gen, priv_validator=pv, db_backend="memdb")
+        await node.start()
+        try:
+            async def reach(h):
+                while node.block_store.height() < h:
+                    await asyncio.sleep(0.02)
+
+            # enough blocks that the old 1000 ms iota would have drifted
+            # header time well past the 10 s max_clock_drift
+            await asyncio.wait_for(reach(15), 60.0)
+            meta = node.block_store.load_block_meta(node.block_store.height())
+            drift_ns = meta.header.time_ns - _time.time_ns()
+            assert drift_ns < _DEFAULT_MAX_CLOCK_DRIFT_NS, (
+                f"header time drifted {drift_ns / 1e9:.2f}s into the future"
+            )
+            # and tightly: iota=1ms over ~15 blocks is at most tens of ms
+            assert drift_ns < 1_000_000_000
         finally:
             await node.stop()

@@ -12,6 +12,13 @@ from tendermint_tpu.libs.metrics import MetricsProvider
 from tendermint_tpu.node import Node
 from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
 
+from tendermint_tpu.types.params import BlockParams as _BP, ConsensusParams as _CP
+
+# time_iota_ms=1: test chains commit ~10 blocks/sec (skip_timeout_commit), so the
+# reference's default 1000 ms BFT-time step would race header time ahead of wall
+# clock and trip clock-drift guards (lite2 + propose-side) under suite load
+_FAST_IOTA_PARAMS = _CP(block=_BP(time_iota_ms=1))
+
 CHAIN_ID = "metrics-chain"
 
 
@@ -20,6 +27,7 @@ def _gen(pvs):
         chain_id=CHAIN_ID,
         genesis_time_ns=1_700_000_000_000_000_000,
         validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
+        consensus_params=_FAST_IOTA_PARAMS,
     )
 
 

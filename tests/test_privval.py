@@ -25,6 +25,13 @@ from tendermint_tpu.types import BlockID, GenesisDoc, GenesisValidator, PartSetH
 from tendermint_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
 from tendermint_tpu.types.proposal import Proposal
 
+from tendermint_tpu.types.params import BlockParams as _BP, ConsensusParams as _CP
+
+# time_iota_ms=1: test chains commit ~10 blocks/sec (skip_timeout_commit), so the
+# reference's default 1000 ms BFT-time step would race header time ahead of wall
+# clock and trip clock-drift guards (lite2 + propose-side) under suite load
+_FAST_IOTA_PARAMS = _CP(block=_BP(time_iota_ms=1))
+
 CHAIN = "pv-chain"
 
 
@@ -246,6 +253,7 @@ class TestRemoteSigner:
             chain_id=CHAIN,
             genesis_time_ns=1_700_000_000_000_000_000,
             validators=[GenesisValidator(file_pv.address(), file_pv.get_pub_key(), 10)],
+            consensus_params=_FAST_IOTA_PARAMS,
         )
         client = SignerClient("127.0.0.1:0", accept_timeout=10.0)
         start_task = asyncio.ensure_future(client.start())

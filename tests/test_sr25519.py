@@ -15,6 +15,13 @@ from tendermint_tpu.crypto import ristretto
 from tendermint_tpu.crypto.sr25519 import Sr25519PrivKey, Sr25519PubKey
 from tendermint_tpu.crypto.strobe import Strobe128, Transcript
 
+from tendermint_tpu.types.params import BlockParams as _BP, ConsensusParams as _CP
+
+# time_iota_ms=1: test chains commit ~10 blocks/sec (skip_timeout_commit), so the
+# reference's default 1000 ms BFT-time step would race header time ahead of wall
+# clock and trip clock-drift guards (lite2 + propose-side) under suite load
+_FAST_IOTA_PARAMS = _CP(block=_BP(time_iota_ms=1))
+
 
 class TestMerlin:
     def test_transcript_known_answer(self):
@@ -202,6 +209,7 @@ class TestSr25519Consensus:
             chain_id="sr-chain",
             genesis_time_ns=1_700_000_000_000_000_000,
             validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
+            consensus_params=_FAST_IOTA_PARAMS,
         )
         nodes = []
         for i, pv in enumerate(pvs):
