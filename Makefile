@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench bench-smoke localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke trace-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -14,6 +14,12 @@ bench:           ## BASELINE benchmarks on the attached chip -> one JSON line
 
 bench-smoke:     ## small-batch engine regression tripwire (~1 min, asserts budgets)
 	$(PY) bench.py --smoke
+
+trace-smoke:     ## short localnet; fails unless every block has a complete propose→commit span chain
+	rm -rf build-trace
+	$(PY) -m tendermint_tpu.cli testnet --validators 4 --output ./build-trace --base-port 28656 --fast
+	$(PY) networks/local/run_localnet.py ./build-trace --duration 8 --trace-check --json
+	rm -rf build-trace
 
 localnet:        ## 4-validator net as OS processes (no docker)
 	$(PY) -m tendermint_tpu.cli testnet --validators 4 --output ./build

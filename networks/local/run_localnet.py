@@ -34,6 +34,10 @@ try:
 except ImportError:  # Python < 3.11
     import tomli as tomllib
 
+# the script lives in networks/local/; the package at the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+from tendermint_tpu.libs import tracing  # noqa: E402
+
 
 def rpc(port: int, path: str):
     with urllib.request.urlopen(f"http://127.0.0.1:{port}/{path}", timeout=2) as r:
@@ -45,6 +49,41 @@ def rpc_port_of(home: str) -> int:
         laddr = tomllib.load(f)["rpc"]["laddr"]
     # "tcp://127.0.0.1:26657" or "127.0.0.1:26657"
     return int(laddr.rsplit(":", 1)[1])
+
+
+def dump_recorder(port: int) -> list:
+    """Flight-recorder events from one node's dump_flight_recorder route."""
+    return rpc(port, "dump_flight_recorder")["result"]["events"]
+
+
+def trace_check(rpc_ports) -> bool:
+    """Every node must show a complete propose→commit span chain for every
+    interior recorded height (edges may be truncated by startup or ring
+    wrap).  This is what `make trace-smoke` asserts."""
+    ok = True
+    for port in rpc_ports:
+        try:
+            chains = tracing.step_chains(dump_recorder(port))
+        except Exception as e:
+            print(f"trace check: node on :{port} unreachable: {e}", file=sys.stderr)
+            ok = False
+            continue
+        interior = sorted(chains)[1:-1]
+        missing = {
+            h: [s for s in tracing.REQUIRED_STEPS if s not in chains[h]]
+            for h in interior
+            if any(s not in chains[h] for s in tracing.REQUIRED_STEPS)
+        }
+        if len(interior) < 3 or missing:
+            print(
+                f"trace check FAILED on :{port}: {len(interior)} interior heights, "
+                f"missing steps: {missing}",
+                file=sys.stderr,
+            )
+            ok = False
+        else:
+            print(f"trace check ok on :{port}: {len(interior)} complete span chains")
+    return ok
 
 
 def poll_heights(rpc_ports) -> list:
@@ -68,6 +107,9 @@ def main() -> int:
                     help="max wait for every node's RPC to report height >= 1")
     ap.add_argument("--json", action="store_true",
                     help="print a JSON result line (commits/sec) at the end")
+    ap.add_argument("--trace-check", action="store_true",
+                    help="fail unless every node's flight recorder shows a complete "
+                    "propose→commit span chain for every interior block")
     args = ap.parse_args()
 
     homes = sorted(
@@ -141,9 +183,18 @@ def main() -> int:
             "startup_s": round(startup_s, 2),
             "heights": heights,
         }
+        # per-block ms timeline from node0's flight recorder — the same
+        # event stream dump_flight_recorder serves; bench.py sources its
+        # e2e_4val_breakdown from this instead of ad-hoc timers
+        try:
+            result["recorder"] = tracing.block_breakdown(dump_recorder(rpc_ports[0]))
+        except Exception as e:
+            print(f"flight recorder dump failed: {e}", file=sys.stderr)
         if min(heights) >= 3 and max(heights) - min(heights) <= 2:
             print("localnet healthy: all nodes committing in lock-step")
             ok = True
+        if args.trace_check and not trace_check(rpc_ports):
+            ok = False
     except KeyboardInterrupt:
         pass
     finally:

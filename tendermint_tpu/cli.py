@@ -270,6 +270,57 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Dump a running node's flight recorder (libs/tracing.py) via the
+    dump_flight_recorder RPC route.  Default output is a human timeline
+    (relative ms since the oldest event); --json emits the raw snapshot;
+    --check exits 1 unless every fully-recorded block has a complete
+    propose→commit span chain (the trace-smoke criterion)."""
+    from .libs import tracing
+    from .rpc.client import HTTPClient
+
+    async def fetch() -> dict:
+        async with HTTPClient(args.rpc_laddr) as c:
+            return await c._call("dump_flight_recorder", {"since": args.since})
+
+    snap = asyncio.run(fetch())
+    events = snap.get("events", [])
+    if args.json:
+        print(json.dumps(snap))
+    else:
+        print(
+            f"flight recorder: enabled={snap.get('enabled')} size={snap.get('size')} "
+            f"next_seq={snap.get('next_seq')} dropped={snap.get('dropped')} "
+            f"events={len(events)}"
+        )
+        t0 = events[0]["t_ns"] if events else 0
+        for ev in events:
+            fields = " ".join(
+                f"{k}={v}" for k, v in ev.items() if k not in ("seq", "t_ns", "kind")
+            )
+            print(f"+{(ev['t_ns'] - t0) / 1e6:12.3f}ms  {ev['kind']:<22} {fields}")
+    if args.check:
+        chains = tracing.step_chains(events)
+        heights = sorted(chains)
+        # ring wrap / startup may truncate the edge heights; interior
+        # heights must each carry the full chain
+        interior = heights[1:-1]
+        missing = {
+            h: [s for s in tracing.REQUIRED_STEPS if s not in chains[h]]
+            for h in interior
+            if any(s not in chains[h] for s in tracing.REQUIRED_STEPS)
+        }
+        if len(interior) < 1 or missing:
+            print(
+                f"trace check FAILED: {len(interior)} interior heights, "
+                f"missing steps: {missing}",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"trace check ok: {len(interior)} blocks with complete span chains")
+    return 0
+
+
 def cmd_version(args) -> int:
     from . import version
 
@@ -404,6 +455,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="number of dumps; 0 with --interval > 0 = until interrupted",
     )
     dp.set_defaults(fn=cmd_debug_dump)
+
+    sp = sub.add_parser("trace", help="dump a running node's flight recorder")
+    sp.add_argument("--rpc-laddr", default="127.0.0.1:26657")
+    sp.add_argument("--since", type=int, default=0, help="seq watermark (previous next_seq)")
+    sp.add_argument("--json", action="store_true", help="raw snapshot JSON")
+    sp.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 1 unless every fully-recorded block has a complete propose→commit chain",
+    )
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

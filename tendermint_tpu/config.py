@@ -169,6 +169,13 @@ class InstrumentationConfig:
     prometheus_listen_addr: str = ":26660"
     max_open_connections: int = 3
     namespace: str = "tendermint"
+    # Flight recorder (libs/tracing.py): always-on ring of hot-path span
+    # events (consensus steps, verify-engine flush/dispatch/compile),
+    # served by the dump_flight_recorder RPC route and the `trace` CLI.
+    # Independent of `prometheus` — the recorder has no listener of its
+    # own and costs ~1 µs/event, so it defaults on.
+    flight_recorder: bool = True
+    flight_recorder_size: int = 8192
 
 
 @dataclass
@@ -234,6 +241,8 @@ class Config:
             raise ValueError("rpc.max_open_connections can't be negative")
         if self.fast_sync.version not in ("v0", "v2"):
             raise ValueError(f"unknown fastsync version {self.fast_sync.version!r}")
+        if self.instrumentation.flight_recorder_size < 1:
+            raise ValueError("instrumentation.flight_recorder_size must be >= 1")
 
 
 def default_config(home: str = "~/.tendermint_tpu") -> Config:

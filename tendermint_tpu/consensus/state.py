@@ -99,9 +99,11 @@ class ConsensusState(Service):
         self.wal = NilWAL()
         self.do_wal_catchup = True
         self.replay_mode = False
+        from ..libs import tracing
         from ..libs.metrics import ConsensusMetrics
 
         self.metrics = ConsensusMetrics()  # nop; node swaps in prometheus
+        self.recorder = tracing.NOP  # node swaps in its FlightRecorder
         self._total_txs = 0
 
         # the round state
@@ -705,6 +707,7 @@ class ConsensusState(Service):
             seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
             self.block_store.save_block(block, block_parts, seen_commit)
         fail_point("finalize-saved-block")
+        self.recorder.record("commit", height=block.height, txs=len(block.txs))
         self._record_metrics(block)
 
         # end-height marker implies the block store has the block (wal.go:46)
@@ -1089,6 +1092,9 @@ class ConsensusState(Service):
     def _update_round_step(self, round_: int, step: int) -> None:
         self.rs.round = round_
         self.rs.step = step
+        self.recorder.record(
+            "step", height=self.rs.height, round=round_, step=RoundStep.NAMES[step]
+        )
 
     async def _new_step(self) -> None:
         """state.go:590 newStep: WAL the round state + notify."""

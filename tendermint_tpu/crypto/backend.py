@@ -69,6 +69,18 @@ def _clib():
     return hostprep._load_lib()
 
 
+def active_tier() -> int:
+    """Best available host-crypto tier for serial ed25519 work:
+    1 = cryptography (OpenSSL), 2 = project C extension, 3 = pure python.
+    Exported as the `tendermint_verify_backend_tier` gauge so a fleet
+    operator can spot the node silently running the slow tier."""
+    if HAVE_CRYPTOGRAPHY:
+        return 1
+    if _clib() is not None:
+        return 2
+    return 3
+
+
 # --------------------------------------------------------------------------
 # ed25519
 # --------------------------------------------------------------------------

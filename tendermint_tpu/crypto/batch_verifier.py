@@ -27,6 +27,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..libs import tracing
+from ..libs.metrics import VerifyMetrics
 from ..libs.service import Service
 from . import batch as batch_hook
 from . import ed25519_math as em
@@ -238,6 +240,25 @@ def _shared_verify_jit(mesh, batch_axis: str):
     return fn
 
 
+def _shared_pallas_fn(tile: int):
+    """Process-wide Pallas verify entry point.  Must be shared for the
+    same reason as the jit wrappers — and because _shared_fused_jit keys
+    by id(inner): a per-instance functools.partial would mint a fresh
+    never-evicted fused-jit cache entry (and a full re-trace) for every
+    PubkeyTable on a TPU backend."""
+    key = ("pallas", tile)
+    with _shared_jit_lock:
+        fn = _shared_jit.get(key)
+        if fn is None:
+            import functools
+
+            from ..ops.ed25519_pallas import verify_prepared_pallas
+
+            fn = functools.partial(verify_prepared_pallas, tile=tile)
+            _shared_jit[key] = fn
+    return fn
+
+
 def _shared_fused_jit(inner):
     """Fused gather+verify wrapper, one per inner verify wrapper (which is
     itself process-wide) — same per-instance re-trace trap as above."""
@@ -266,9 +287,22 @@ class BatchVerifier:
     portable XLA kernel (ops/ed25519.py) is used instead.
     """
 
-    def __init__(self, mesh=None, batch_axis: str = "batch", min_device_batch: int = 1):
+    def __init__(
+        self,
+        mesh=None,
+        batch_axis: str = "batch",
+        min_device_batch: int = 1,
+        metrics: Optional[VerifyMetrics] = None,
+        recorder=None,
+    ):
         self.mesh = mesh
         self.batch_axis = batch_axis
+        # observability: nop by default; the node passes its provider's
+        # VerifyMetrics and its FlightRecorder.  PubkeyTable / TableCache /
+        # AsyncBatchVerifier all report through their verifier's pair, so
+        # wiring the one engine instance instruments the whole pipeline.
+        self.metrics = metrics if metrics is not None else VerifyMetrics()
+        self.recorder = recorder if recorder is not None else tracing.NOP
         # Batches below this ride the serial host path: a tiny batch's
         # device dispatch (dominated by host<->device RTT on remote-attached
         # TPUs) costs more than ~0.15 ms/sig host verification.  1 = always
@@ -336,6 +370,12 @@ class BatchVerifier:
             "prep_ms_per_chunk": prep_ms_per_chunk,
             "chunked_selected": float(rtt_ms < prep_ms_per_chunk),
         }
+        self.recorder.record(
+            "verify.chunked",
+            selected=bool(rtt_ms < prep_ms_per_chunk),
+            rtt_ms=round(rtt_ms, 4),
+            prep_ms=round(prep_ms_per_chunk, 4),
+        )
         return self.rtt_probe
 
     def chunked_auto(self) -> bool:
@@ -371,7 +411,10 @@ class BatchVerifier:
             self._compiling_buckets.add(b)
 
         def _compile():
+            import time as _time
+
             ok = False
+            t0 = _time.perf_counter()
             try:
                 self._compile_bucket(b)
                 ok = True
@@ -380,6 +423,13 @@ class BatchVerifier:
             with self._warm_lock:
                 self._compiling_buckets.discard(b)
                 (self._ready_buckets if ok else self._failed_buckets).add(b)
+            self.metrics.bucket_compiles.inc()
+            self.recorder.record(
+                "verify.bucket_compile",
+                bucket=b,
+                ms=round((_time.perf_counter() - t0) * 1000, 3),
+                ok=ok,
+            )
 
         # non-daemon: a daemon thread killed mid-XLA-compile at interpreter
         # exit aborts the whole process from C++ ("terminate called");
@@ -411,11 +461,7 @@ class BatchVerifier:
     def _jitted_locked(self):
         if self._fn is None:
             if self._use_pallas():
-                import functools
-
-                from ..ops.ed25519_pallas import verify_prepared_pallas
-
-                self._fn = functools.partial(verify_prepared_pallas, tile=_PALLAS_TILE)
+                self._fn = _shared_pallas_fn(_PALLAS_TILE)
             else:
                 self._fn = _shared_verify_jit(self.mesh, self.batch_axis)
         return self._fn
@@ -440,21 +486,44 @@ class BatchVerifier:
     def verify(
         self, pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
     ) -> List[bool]:
+        import time as _time
+
         n = len(sigs)
         if n == 0:
             return []
+        self.metrics.batch_size.observe(n)
         if n < self.min_device_batch:
-            return batch_hook.host_batch_verify(pubkeys, msgs, sigs)
+            t0 = _time.perf_counter()
+            out = batch_hook.host_batch_verify(pubkeys, msgs, sigs)
+            self.recorder.record(
+                "verify.dispatch", n=n, bucket=0, path="host",
+                host_prep_ms=0.0,
+                device_ms=round((_time.perf_counter() - t0) * 1000, 3),
+            )
+            return out
         b = self._bucket(n)
         if not self._bucket_ready(b):
+            self.recorder.record("verify.dispatch", n=n, bucket=b, path="host-cold",
+                                 host_prep_ms=0.0, device_ms=0.0)
             return batch_hook.host_batch_verify(pubkeys, msgs, sigs)
+        t0 = _time.perf_counter()
         neg_a, h_digits, s_digits, r_y, r_sign, valid = prepare_batch(pubkeys, msgs, sigs)
+        prep_s = _time.perf_counter() - t0
+        self.metrics.host_prep_seconds.observe(prep_s)
         if not valid.any():
             return [False] * n
         if b > n:
             neg_a = np.concatenate([neg_a, np.tile(neg_a[-1:], (b - n, 1, 1))])
         h_digits, s_digits, r_y, r_sign = _pad_scalar_rows(b, h_digits, s_digits, r_y, r_sign)
+        t1 = _time.perf_counter()
         ok = np.asarray(self._jitted()(neg_a, h_digits, s_digits, r_y, r_sign))[:n]
+        dev_s = _time.perf_counter() - t1
+        self.metrics.device_seconds.observe(dev_s)
+        self.recorder.record(
+            "verify.dispatch", n=n, bucket=b, path="device",
+            host_prep_ms=round(prep_s * 1000, 3),
+            device_ms=round(dev_s * 1000, 3),
+        )
         return list(np.logical_and(ok, valid))
 
     def install(self) -> "BatchVerifier":
@@ -561,10 +630,13 @@ class PubkeyTable:
         self, idxs: Sequence[int], msgs: Sequence[bytes], sigs: Sequence[bytes]
     ) -> List[bool]:
         """Verify msgs[i]/sigs[i] against table row idxs[i]."""
+        import time as _time
+
         n = len(sigs)
         if n == 0:
             return []
         pk_count = len(self.pubkeys)
+        self.verifier.metrics.batch_size.observe(n)
         if n < self.verifier.min_device_batch:
             return batch_hook.host_batch_verify(
                 [
@@ -593,6 +665,7 @@ class PubkeyTable:
             # latency ≈ prep(chunk 1) + device(total) instead of
             # prep(total) + device(total).
             fn = self._fused()
+            t0 = _time.perf_counter()
             pending = []
             for start in range(0, n, _CHUNK):
                 end = min(start + _CHUNK, n)
@@ -607,9 +680,19 @@ class PubkeyTable:
             out: List[bool] = []
             for dev_ok, valid_c, cnt in pending:
                 out.extend(np.logical_and(np.asarray(dev_ok)[:cnt], valid_c).tolist())
+            # prep and device time interleave by design here; report the
+            # overlapped wall time as device_ms and mark the path
+            self.verifier.recorder.record(
+                "verify.dispatch", n=n, bucket=_CHUNK, path="chunked",
+                host_prep_ms=0.0,
+                device_ms=round((_time.perf_counter() - t0) * 1000, 3),
+            )
             return out
 
+        t0 = _time.perf_counter()
         h_digits, s_digits, r_y, r_sign, valid = _scalar_rows(items)
+        prep_s = _time.perf_counter() - t0
+        self.verifier.metrics.host_prep_seconds.observe(prep_s)
         if not valid.any():
             return [False] * n
 
@@ -624,6 +707,7 @@ class PubkeyTable:
             if b > n:
                 idx_arr = np.concatenate([idx_arr, np.zeros(b - n, dtype=np.int32)])
             idx_arr = np.clip(idx_arr, 0, pk_count - 1)
+            t1 = _time.perf_counter()
             ok = np.asarray(
                 ed25519_table.verify_tabulated(
                     self.build_tables(),
@@ -636,6 +720,13 @@ class PubkeyTable:
                     interpret=self._interpret,
                 )
             )[:n]
+            dev_s = _time.perf_counter() - t1
+            self.verifier.metrics.device_seconds.observe(dev_s)
+            self.verifier.recorder.record(
+                "verify.dispatch", n=n, bucket=b, path="tabulated",
+                host_prep_ms=round(prep_s * 1000, 3),
+                device_ms=round(dev_s * 1000, 3),
+            )
             return list(np.logical_and(ok, valid))
 
         b = self.verifier._bucket(n)
@@ -643,9 +734,17 @@ class PubkeyTable:
         if b > n:
             idx_arr = np.concatenate([idx_arr, np.zeros(b - n, dtype=np.int32)])
         idx_arr = np.clip(idx_arr, 0, pk_count - 1)
+        t1 = _time.perf_counter()
         ok = np.asarray(
             self._fused()(self.neg_a_rows, idx_arr, h_digits, s_digits, r_y, r_sign)
         )[:n]
+        dev_s = _time.perf_counter() - t1
+        self.verifier.metrics.device_seconds.observe(dev_s)
+        self.verifier.recorder.record(
+            "verify.dispatch", n=n, bucket=b, path="indexed",
+            host_prep_ms=round(prep_s * 1000, 3),
+            device_ms=round(dev_s * 1000, 3),
+        )
         return list(np.logical_and(ok, valid))
 
 
@@ -707,7 +806,11 @@ class TableCache:
             if tab is not None:
                 self._tables.move_to_end(set_key)
         if tab is not None:
+            self.verifier.metrics.table_cache_hits.inc()
+            self.verifier.recorder.record("verify.table", hit=True, n=len(sigs))
             return tab.verify_indexed(idxs, msgs, sigs)
+        self.verifier.metrics.table_cache_misses.inc()
+        self.verifier.recorder.record("verify.table", hit=False, n=len(sigs))
         if not self.verifier._warmup_mode:
             return self.table_for(set_key, self._rows(pubkeys)).verify_indexed(idxs, msgs, sigs)
         # Node mode: building (decompress + device table compile, seconds at
@@ -795,7 +898,9 @@ class AsyncBatchVerifier(Service):
         self.flush_min = min(flush_min, flush_interval)
         self.adaptive = adaptive
         self.max_pending = max_pending
-        self._pending: List[Tuple[bytes, bytes, bytes, asyncio.Future]] = []
+        # (pubkey, msg, sig, fut, t_enqueued) — the timestamp feeds the
+        # queue-wait histogram and the flight recorder's flush spans
+        self._pending: List[Tuple[bytes, bytes, bytes, asyncio.Future, float]] = []
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._executor = None
@@ -824,7 +929,7 @@ class AsyncBatchVerifier(Service):
                 await self._task
             except asyncio.CancelledError:
                 pass
-        for _, _, _, fut in self._pending:
+        for _, _, _, fut, _ in self._pending:
             if not fut.done():
                 fut.cancel()
         self._pending.clear()
@@ -852,7 +957,8 @@ class AsyncBatchVerifier(Service):
             )
         self._last_arrival = now
         self._enqueued += 1
-        self._pending.append((pubkey, msg, sig, fut))
+        self._pending.append((pubkey, msg, sig, fut, now))
+        self.verifier.recorder.record("verify.enqueue", pending=len(self._pending))
         if self._wake and (self.adaptive or len(self._pending) >= self.max_batch):
             self._wake.set()
         return fut
@@ -908,6 +1014,18 @@ class AsyncBatchVerifier(Service):
             del self._pending[: self.max_batch]
             if len(self._pending) >= self.max_batch and self._wake:
                 self._wake.set()
+            now = loop.time()
+            wait_s = max(0.0, now - batch[0][4])  # oldest entry's queue wait
+            quantum_s = self._quiet_window() if self.adaptive else self.flush_interval
+            m = self.verifier.metrics
+            m.queue_wait_seconds.observe(wait_s)
+            m.flush_quantum_seconds.set(quantum_s)
+            self.verifier.recorder.record(
+                "verify.flush",
+                batch=len(batch),
+                wait_ms=round(wait_s * 1000, 3),
+                quantum_ms=round(quantum_s * 1000, 3),
+            )
             pubkeys = [b[0] for b in batch]
             msgs = [b[1] for b in batch]
             sigs = [b[2] for b in batch]
@@ -916,17 +1034,17 @@ class AsyncBatchVerifier(Service):
                     self._executor, self.verifier.verify, pubkeys, msgs, sigs
                 )
             except asyncio.CancelledError:
-                for _, _, _, fut in batch:
+                for _, _, _, fut, _ in batch:
                     if not fut.done():
                         fut.cancel()
                 raise
             except Exception as e:
                 # a dead flusher would strand every pending + future caller;
                 # fail this batch's futures and keep the loop alive
-                for _, _, _, fut in batch:
+                for _, _, _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(RuntimeError(f"batch verify failed: {e!r}"))
                 continue
-            for (_, _, _, fut), ok in zip(batch, results):
+            for (_, _, _, fut, _), ok in zip(batch, results):
                 if not fut.done():
                     fut.set_result(bool(ok))

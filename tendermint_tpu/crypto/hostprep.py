@@ -99,6 +99,22 @@ def _load_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_uint64, u8p,
         ]
         lib.ed25519_verify_batch.restype = None
+        # crypto.backend tier-2 entry points: the uint64_t length params
+        # MUST be declared — without argtypes ctypes marshals Python ints
+        # as 32-bit c_int into 64-bit slots (UB; garbage upper bits on
+        # ABIs that don't zero-extend narrow args)
+        lib.ed25519_sign.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        lib.ed25519_sign.restype = None
+        aead_args = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        lib.chacha20poly1305_seal.argtypes = aead_args
+        lib.chacha20poly1305_seal.restype = None
+        lib.chacha20poly1305_open.argtypes = aead_args
         lib.chacha20poly1305_open.restype = ctypes.c_int
         _lib = lib
     except Exception:

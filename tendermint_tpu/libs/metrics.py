@@ -182,6 +182,75 @@ class StateMetrics:
         ).labels(chain_id=chain_id)
 
 
+class VerifyMetrics:
+    """The TPU batch-verify engine (subsystem `verify`; no reference
+    counterpart — the reference verifies serially and has nothing to
+    batch, schedule or compile).  Exposes the quantities the engine's
+    batching/scheduling decisions turn on: batch sizes, queue wait,
+    host-prep vs device split, the adaptive flush quantum, background
+    bucket compiles, table-cache hit rate and the active host-crypto
+    backend tier (1=cryptography, 2=project C ext, 3=pure python)."""
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        if registry is None:
+            for name in (
+                "batch_size", "queue_wait_seconds", "host_prep_seconds",
+                "device_seconds", "flush_quantum_seconds", "bucket_compiles",
+                "table_cache_hits", "table_cache_misses", "backend_tier",
+            ):
+                setattr(self, name, _NOP)
+            return
+        from prometheus_client import Counter, Gauge, Histogram
+
+        sub = "verify"
+        kw = dict(namespace=NAMESPACE, subsystem=sub, registry=registry,
+                  labelnames=("chain_id",))
+
+        def h(name, doc, buckets):
+            return Histogram(name, doc, buckets=buckets, **kw).labels(chain_id=chain_id)
+
+        def g(name, doc):
+            return Gauge(name, doc, **kw).labels(chain_id=chain_id)
+
+        def c(name, doc):
+            return Counter(name, doc, **kw).labels(chain_id=chain_id)
+
+        self.batch_size = h(
+            "batch_size", "Signatures per verify dispatch.",
+            [2**i for i in range(0, 14)],
+        )
+        time_buckets = [1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2,
+                       2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0]
+        self.queue_wait_seconds = h(
+            "queue_wait_seconds",
+            "Oldest enqueue-to-flush wait per batcher flush.", time_buckets,
+        )
+        self.host_prep_seconds = h(
+            "host_prep_seconds", "Host prep (hash/reduce/pack) per batch.",
+            time_buckets,
+        )
+        self.device_seconds = h(
+            "device_seconds", "Device dispatch + fetch per batch.", time_buckets,
+        )
+        self.flush_quantum_seconds = g(
+            "flush_quantum_seconds",
+            "Current adaptive coalescing window of the vote batcher.",
+        )
+        self.bucket_compiles = c(
+            "bucket_compiles", "Background XLA bucket-shape compiles."
+        )
+        self.table_cache_hits = c(
+            "table_cache_hits", "Indexed verifies served from a cached pubkey table."
+        )
+        self.table_cache_misses = c(
+            "table_cache_misses", "Indexed verifies that had to build (or decline to) a table."
+        )
+        self.backend_tier = g(
+            "backend_tier",
+            "Active host crypto backend: 1=cryptography, 2=C extension, 3=pure python.",
+        )
+
+
 class MetricsProvider:
     """node/node.go:128 DefaultMetricsProvider — one registry per node."""
 
@@ -197,6 +266,7 @@ class MetricsProvider:
         self.p2p = P2PMetrics(self.registry, chain_id)
         self.mempool = MempoolMetrics(self.registry, chain_id)
         self.state = StateMetrics(self.registry, chain_id)
+        self.verify = VerifyMetrics(self.registry, chain_id)
 
     def exposition(self) -> bytes:
         if self.registry is None:
@@ -220,14 +290,18 @@ class MetricsServer:
         self._runner = None
         self.bound_addr: Optional[str] = None
 
+    # the exposition content type Prometheus scrapers negotiate for
+    # (text format version 0.0.4); aiohttp's content_type kwarg cannot
+    # carry the version parameter, so the header is set verbatim
+    CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
     async def start(self) -> None:
         from aiohttp import web
 
         async def metrics(request):
             return web.Response(
                 body=self.provider.exposition(),
-                content_type="text/plain",
-                charset="utf-8",
+                headers={"Content-Type": self.CONTENT_TYPE},
             )
 
         app = web.Application()
@@ -237,7 +311,15 @@ class MetricsServer:
         addr = self.listen_addr
         host, _, port = addr.split("://")[-1].rpartition(":")
         site = web.TCPSite(runner, host or "127.0.0.1", int(port))
-        await site.start()
+        try:
+            await site.start()
+        except OSError as e:
+            # a bare EADDRINUSE without the address sends the operator
+            # hunting through every listener the node opens
+            await runner.cleanup()
+            raise OSError(
+                f"metrics server failed to bind {self.listen_addr!r}: {e}"
+            ) from e
         self._runner = runner
         for s in runner.sites:
             srv = getattr(s, "_server", None)
@@ -246,5 +328,9 @@ class MetricsServer:
         self.bound_addr = self.bound_addr or addr
 
     async def stop(self) -> None:
-        if self._runner is not None:
-            await self._runner.cleanup()
+        # idempotent: node teardown paths may stop twice (error unwind +
+        # on_stop sweep); the second call must be a no-op, not a cleanup
+        # of an already-cleaned runner
+        runner, self._runner = self._runner, None
+        if runner is not None:
+            await runner.cleanup()

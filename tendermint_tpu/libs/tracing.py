@@ -1,0 +1,173 @@
+"""Flight recorder: an always-on ring buffer of hot-path span events.
+
+No reference counterpart — the reference's hot path (serial per-signature
+verification) has nothing worth tracing; this framework's batched TPU
+verify pipeline (crypto/batch_verifier.py) and the consensus step machine
+do, and Prometheus histograms alone cannot answer "what did block 1234
+spend its milliseconds on".  The recorder keeps the last N events
+(monotonic-clock timestamped, fixed memory) so a bench rig, the
+`dump_flight_recorder` RPC route and the `tendermint_tpu trace` CLI all
+read the SAME event stream production telemetry comes from.
+
+Event kinds currently emitted:
+
+  consensus (consensus/state.py):
+    step              height, round, step      every H/R/S transition
+    commit            height, txs              block finalized
+  verify engine (crypto/batch_verifier.py):
+    verify.enqueue    pending                  vote entered the batcher
+    verify.flush      batch, wait_ms, quantum_ms   batcher coalesced a flush
+    verify.dispatch   n, bucket, path, host_prep_ms, device_ms
+    verify.bucket_compile  bucket, ms, ok      background XLA compile done
+    verify.chunked    selected, rtt_ms, prep_ms    RTT-probe decision
+    verify.table      hit, n                   TableCache lookup
+
+Events are flat dicts: {"seq", "t_ns", "kind", **fields}.  `t_ns` is
+time.monotonic_ns() — deltas are meaningful, wall-clock is not.
+
+Performance contract: `record` on a disabled recorder (or the module NOP)
+is one attribute check; enabled it is one uncontended lock, one
+monotonic_ns call, one tuple and one list store — well under a
+microsecond (tests/test_tracing.py tripwires the budget).  Writers may be
+the event loop, the flush executor or warmup threads concurrently; the
+lock makes seq order equal timestamp order, which the span-chain
+consumers rely on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+
+class NopRecorder:
+    """Disabled-path recorder: accepts events and drops them."""
+
+    enabled = False
+    size = 0
+
+    def record(self, kind: str, **fields) -> None:
+        pass
+
+    def events(self, since: int = 0) -> List[dict]:
+        return []
+
+    def snapshot(self, since: int = 0) -> dict:
+        return {"enabled": False, "size": 0, "next_seq": 0, "events": []}
+
+
+NOP = NopRecorder()
+
+
+class FlightRecorder:
+    """Fixed-size ring of span events; `enabled=False` degrades to the nop
+    fast path while keeping one object type at every call site."""
+
+    __slots__ = ("size", "enabled", "_buf", "_seq", "_lock")
+
+    def __init__(self, size: int = 8192, enabled: bool = True):
+        if size < 1:
+            raise ValueError("flight recorder size must be >= 1")
+        self.size = size
+        self.enabled = enabled
+        self._buf: List[Optional[tuple]] = [None] * size
+        self._seq = 0  # next sequence number; monotonic, never wraps
+        # an uncontended Lock costs ~0.1 µs and guarantees seq order ==
+        # timestamp order across writer threads (the monotonicity the
+        # span-chain consumers rely on)
+        self._lock = threading.Lock()
+
+    def record(self, kind: str, **fields) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            i = self._seq
+            self._seq = i + 1
+            self._buf[i % self.size] = (i, time.monotonic_ns(), kind, fields)
+
+    def events(self, since: int = 0) -> List[dict]:
+        """Events still in the ring with seq >= since, oldest first."""
+        out = []
+        for ev in self._buf:
+            if ev is not None and ev[0] >= since:
+                out.append(ev)
+        out.sort(key=lambda ev: ev[0])
+        return [
+            {"seq": seq, "t_ns": t_ns, "kind": kind, **fields}
+            for seq, t_ns, kind, fields in out
+        ]
+
+    def snapshot(self, since: int = 0) -> dict:
+        """The dump_flight_recorder RPC payload.  `next_seq` lets a poller
+        pass it back as `since` to stream only fresh events; dropped =
+        events that aged out of the ring before this snapshot."""
+        events = self.events(since)
+        return {
+            "enabled": self.enabled,
+            "size": self.size,
+            "next_seq": self._seq,
+            "dropped": max(0, self._seq - self.size),
+            "events": events,
+        }
+
+
+def step_chains(events: List[dict]) -> dict:
+    """Group `step` events into per-height chains: {height: {step_name:
+    first_t_ns}}.  The shared consumer for the bench breakdown, the
+    trace-smoke check and the CLI — one definition of "a block's span
+    chain" everywhere."""
+    chains: dict = {}
+    for ev in events:
+        if ev.get("kind") != "step":
+            continue
+        chains.setdefault(ev["height"], {}).setdefault(ev["step"], ev["t_ns"])
+    return chains
+
+
+#: The steps every committed height must pass through, in order.  Wait
+#: steps (PrevoteWait/PrecommitWait) and extra rounds are optional.
+REQUIRED_STEPS = ("Propose", "Prevote", "Precommit", "Commit")
+
+
+def complete_heights(chains: dict) -> List[int]:
+    """Heights with a full propose→commit chain, ascending."""
+    return sorted(
+        h for h, steps in chains.items() if all(s in steps for s in REQUIRED_STEPS)
+    )
+
+
+def block_breakdown(events: List[dict]) -> Optional[dict]:
+    """Median per-step milliseconds across every complete span chain in
+    the event stream: how long each height sat in Propose / Prevote /
+    Precommit, commit→next-height turnaround, and total block time
+    (propose(h) → propose(h+1)).  None when fewer than 2 complete,
+    consecutive chains exist."""
+    chains = step_chains(events)
+    heights = complete_heights(chains)
+    propose_ms, prevote_ms, precommit_ms, commit_ms, block_ms = [], [], [], [], []
+    for h in heights:
+        steps = chains[h]
+        propose_ms.append((steps["Prevote"] - steps["Propose"]) / 1e6)
+        prevote_ms.append((steps["Precommit"] - steps["Prevote"]) / 1e6)
+        precommit_ms.append((steps["Commit"] - steps["Precommit"]) / 1e6)
+        nxt = chains.get(h + 1)
+        if nxt and "Propose" in nxt:
+            commit_ms.append((nxt["Propose"] - steps["Commit"]) / 1e6)
+            block_ms.append((nxt["Propose"] - steps["Propose"]) / 1e6)
+    if not block_ms:
+        return None
+
+    def med(xs: List[float]) -> float:
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    return {
+        "source": "flight_recorder",
+        "blocks": len(block_ms),
+        "propose_ms": round(med(propose_ms), 3),
+        "prevote_ms": round(med(prevote_ms), 3),
+        "precommit_ms": round(med(precommit_ms), 3),
+        "commit_ms": round(med(commit_ms), 3),
+        "block_ms": round(med(block_ms), 3),
+    }

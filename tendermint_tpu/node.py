@@ -104,9 +104,28 @@ class Node(Service):
         self.metrics_provider = None
         self.metrics_server = None
         self.grpc_server = None
+        # flight recorder: always constructed (cheap), so the RPC dump
+        # route exists whether or not prometheus is on; enabled/size from
+        # the [instrumentation] config section
+        from .libs.tracing import FlightRecorder
+
+        self.flight_recorder = FlightRecorder(
+            size=config.instrumentation.flight_recorder_size,
+            enabled=config.instrumentation.flight_recorder,
+        )
 
     async def on_start(self) -> None:
         cfg = self.config
+        # metrics provider (node/node.go:128) — per-node registry; built
+        # before the verify engine so the engine reports through it
+        from .libs.metrics import MetricsProvider
+
+        self.metrics_provider = MetricsProvider(
+            cfg.instrumentation.prometheus, self.genesis_doc.chain_id
+        )
+        from .crypto import backend as _crypto_backend
+
+        self.metrics_provider.verify.backend_tier.set(_crypto_backend.active_tier())
         # TPU batch-verify engine first: every downstream consumer of
         # crypto.batch.get_verifier() (handshake replay, fastsync,
         # verify_commit in block validation) must already see the device
@@ -123,7 +142,10 @@ class Node(Service):
                 devs = jax.devices()[: cfg.tpu.mesh_devices]
                 mesh = Mesh(devs, ("batch",))
             self.batch_verifier = BatchVerifier(
-                mesh=mesh, min_device_batch=cfg.tpu.min_device_batch
+                mesh=mesh,
+                min_device_batch=cfg.tpu.min_device_batch,
+                metrics=self.metrics_provider.verify,
+                recorder=self.flight_recorder,
             ).install()
             # steady-state commit path: per-valset device tables (HBM rows;
             # tabulated zero-doubling windows on a TPU backend)
@@ -165,12 +187,6 @@ class Node(Service):
             open_db("evidence", home, cfg.base.db_backend), self.state_store
         )
 
-        # metrics provider (node/node.go:128) — per-node registry
-        from .libs.metrics import MetricsProvider
-
-        self.metrics_provider = MetricsProvider(
-            cfg.instrumentation.prometheus, self.genesis_doc.chain_id
-        )
         self.mempool.metrics = self.metrics_provider.mempool
 
         block_exec = BlockExecutor(
@@ -192,6 +208,7 @@ class Node(Service):
             event_bus=self.event_bus,
         )
         self.consensus.metrics = self.metrics_provider.consensus
+        self.consensus.recorder = self.flight_recorder
         if self.priv_validator is not None:
             self.consensus.set_priv_validator(self.priv_validator)
         cfg.ensure_dirs()

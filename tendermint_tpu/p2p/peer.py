@@ -26,12 +26,14 @@ class Peer(Service):
         persistent: bool = False,
         socket_addr: str = "",
         mconfig: Optional[dict] = None,
+        on_send_bytes=None,  # fn(chan_id, n) — switch wires send accounting
     ):
         super().__init__(f"peer-{node_info.node_id[:8]}")
         self.node_info = node_info
         self.outbound = outbound
         self.persistent = persistent
         self.socket_addr = socket_addr
+        self._on_send_bytes = on_send_bytes
         self.remote_ip = getattr(conn, "remote_ip", "")
         self.log = get_logger(f"peer:{node_info.node_id[:8]}")
         self._data: Dict[str, object] = {}  # reactor scratch (peer.Set/Get)
@@ -56,10 +58,19 @@ class Peer(Service):
             await self.mconn.stop()
 
     async def send(self, chan_id: int, msg: bytes) -> bool:
-        return await self.mconn.send(chan_id, msg)
+        ok = await self.mconn.send(chan_id, msg)
+        # counted on acceptance into the channel queue, the send-side
+        # mirror of the switch's receive accounting (p2p/metrics.go
+        # PeerSendBytesTotal; the reference likewise counts at Send)
+        if ok and self._on_send_bytes is not None:
+            self._on_send_bytes(chan_id, len(msg))
+        return ok
 
     def try_send(self, chan_id: int, msg: bytes) -> bool:
-        return self.mconn.try_send(chan_id, msg)
+        ok = self.mconn.try_send(chan_id, msg)
+        if ok and self._on_send_bytes is not None:
+            self._on_send_bytes(chan_id, len(msg))
+        return ok
 
     def get(self, key: str):
         return self._data.get(key)

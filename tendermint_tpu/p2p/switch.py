@@ -191,6 +191,12 @@ class Switch(Service):
                 self.log.info("peer filtered", peer=ni.node_id[:12], reason=reason)
                 conn.close()
                 return None
+        def _count_send_bytes(chan_id: int, n: int, peer_id: str = ni.node_id) -> None:
+            # mirrors the receive-side accounting in _on_peer_receive
+            self.metrics.peer_send_bytes_total.labels(
+                chain_id=self.node_info.network, peer_id=peer_id, chID=str(chan_id)
+            ).inc(n)
+
         peer = Peer(
             conn,
             ni,
@@ -200,6 +206,7 @@ class Switch(Service):
             outbound=outbound,
             persistent=persistent or ni.node_id in self.persistent_addrs,
             socket_addr=addr,
+            on_send_bytes=_count_send_bytes,
         )
         if self.fuzz_config is not None:
             from .fuzz import PeerFuzz

@@ -52,6 +52,7 @@ class RPCCore:
         "consensus_params",
         "consensus_state",
         "dump_consensus_state",
+        "dump_flight_recorder",
         "unconfirmed_txs",
         "num_unconfirmed_txs",
         "broadcast_tx_async",
@@ -385,6 +386,17 @@ class RPCCore:
                     }
                 )
         return {"round_state": self._round_state_dict(full=True), "peers": peers}
+
+    async def dump_flight_recorder(self, since: int = 0) -> dict:
+        """Drain the node's flight recorder (libs/tracing.py): the ring of
+        consensus-step and verify-engine span events.  `since` is a seq
+        watermark — pass the previous response's `next_seq` to poll only
+        fresh events.  Safe route: bounded payload (ring-sized), no node
+        mutation."""
+        rec = getattr(self.node, "flight_recorder", None)
+        if rec is None:
+            return {"enabled": False, "size": 0, "next_seq": 0, "dropped": 0, "events": []}
+        return rec.snapshot(since=since)
 
     # -- mempool routes ----------------------------------------------------
 

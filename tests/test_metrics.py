@@ -71,10 +71,85 @@ class TestProvider:
         assert b'chain-a' in a.exposition() and b'chain-b' in b.exposition()
 
 
+class TestNopDriftGuard:
+    def test_nop_attrs_exactly_match_prometheus_attrs(self):
+        """Every metrics class must expose the SAME attribute set on its
+        nop path and its prometheus path — a metric defined in only one
+        of the two is silently dead (the peer_send_bytes_total bug class:
+        defined, exported as 0, never incremented anywhere)."""
+        import inspect
+
+        from prometheus_client import CollectorRegistry
+
+        import tendermint_tpu.libs.metrics as metrics_mod
+
+        classes = [
+            cls
+            for name, cls in vars(metrics_mod).items()
+            if inspect.isclass(cls) and name.endswith("Metrics")
+        ]
+        names = {cls.__name__ for cls in classes}
+        assert {
+            "ConsensusMetrics", "P2PMetrics", "MempoolMetrics",
+            "StateMetrics", "VerifyMetrics",
+        } <= names
+        for cls in classes:
+            nop = cls(None, "drift-chain")
+            prom = cls(CollectorRegistry(), "drift-chain")
+            assert set(vars(nop)) == set(vars(prom)), (
+                f"{cls.__name__}: nop/prometheus attribute drift: "
+                f"{set(vars(nop)) ^ set(vars(prom))}"
+            )
+
+    def test_provider_exposes_every_subsystem(self):
+        p = MetricsProvider(True, CHAIN_ID)
+        for sub in ("consensus", "p2p", "mempool", "state", "verify"):
+            assert getattr(p, sub) is not None
+
+
+class TestMetricsServer:
+    async def test_stop_is_idempotent_and_content_type_versioned(self):
+        from tendermint_tpu.libs.metrics import MetricsServer
+
+        provider = MetricsProvider(True, CHAIN_ID)
+        srv = MetricsServer(provider, "127.0.0.1:0")
+        await srv.start()
+        try:
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"http://{srv.bound_addr}/metrics") as r:
+                    assert r.status == 200
+                    assert r.headers["Content-Type"] == (
+                        "text/plain; version=0.0.4; charset=utf-8"
+                    )
+        finally:
+            await srv.stop()
+        await srv.stop()  # second stop must be a no-op, not a crash
+
+    async def test_bind_failure_names_the_configured_address(self):
+        import pytest
+
+        from tendermint_tpu.libs.metrics import MetricsServer
+
+        provider = MetricsProvider(True, CHAIN_ID)
+        first = MetricsServer(provider, "127.0.0.1:0")
+        await first.start()
+        try:
+            addr = first.bound_addr
+            second = MetricsServer(MetricsProvider(True, CHAIN_ID), addr)
+            with pytest.raises(OSError, match=addr.replace(".", r"\.")):
+                await second.start()
+        finally:
+            await first.stop()
+
+
 class TestLiveScrape:
     async def test_scrape_running_net(self, tmp_path):
-        """Two-validator net, node0 serving /metrics: height advances,
-        peers gauge is live, validators/power populated."""
+        """Two-validator net, node0 serving /metrics with the verify
+        engine ON: height advances, peers gauge is live, verify-subsystem
+        series populated, send-bytes counted, flight-recorder span chains
+        complete and monotonic."""
         pvs = sorted([MockPV() for _ in range(2)], key=lambda pv: pv.address())
         gen = _gen(pvs)
         nodes = []
@@ -88,6 +163,10 @@ class TestLiveScrape:
             if i == 0:
                 cfg.instrumentation.prometheus = True
                 cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+                # the engine on: its vote-ingress batcher and metrics are
+                # what this scrape asserts (tiny batches ride the host
+                # path inside the engine — no device compile stall)
+                cfg.tpu.enabled = True
             nodes.append(Node(cfg, gen, priv_validator=pv, db_backend="memdb"))
         try:
             for n in nodes:
@@ -120,6 +199,35 @@ class TestLiveScrape:
             # counters keep the reference names (no _total suffix)
             assert f"tendermint_mempool_failed_txs{{{key}}}" in metrics
             assert f"tendermint_mempool_recheck_times{{{key}}}" in metrics
+
+            # verify subsystem: the vote-ingress batcher flushed real
+            # batches, so the histograms observed and the quantum gauge is live
+            assert metrics[f"tendermint_verify_batch_size_count{{{key}}}"] > 0
+            assert metrics[f"tendermint_verify_queue_wait_seconds_count{{{key}}}"] > 0
+            assert f"tendermint_verify_flush_quantum_seconds{{{key}}}" in metrics
+            assert metrics[f"tendermint_verify_backend_tier{{{key}}}"] in (1, 2, 3)
+
+            # send-side byte accounting mirrors the receive side: gossip to
+            # the peer must have produced nonzero send-bytes series
+            sent = sum(
+                v for k, v in metrics.items()
+                if k.startswith("tendermint_p2p_peer_send_bytes_total{")
+            )
+            assert sent > 0, "peer_send_bytes_total never incremented"
+
+            # flight recorder via the RPC route: complete, monotonic span
+            # chains for the committed heights
+            from tendermint_tpu.libs import tracing
+            from tendermint_tpu.rpc.core import RPCCore
+
+            snap = await RPCCore(nodes[0]).call("dump_flight_recorder")
+            assert snap["enabled"] is True
+            ts = [e["t_ns"] for e in snap["events"]]
+            assert ts == sorted(ts), "recorder events not monotonic"
+            chains = tracing.step_chains(snap["events"])
+            complete = tracing.complete_heights(chains)
+            assert len(complete) >= 2, f"no complete span chains: {chains}"
+            assert any(e["kind"] == "verify.flush" for e in snap["events"])
         finally:
             for n in nodes:
                 if n.is_running:

@@ -1,0 +1,137 @@
+"""Flight recorder (libs/tracing.py): ring semantics, overhead budget,
+span-chain analysis, the dump_flight_recorder RPC route and the
+verify-engine event stream."""
+
+import time
+
+from tendermint_tpu.libs import tracing
+from tendermint_tpu.libs.tracing import FlightRecorder, NopRecorder
+
+
+class TestRing:
+    def test_wraps_and_keeps_last_size_events(self):
+        r = FlightRecorder(size=8)
+        for i in range(20):
+            r.record("step", height=i)
+        evs = r.events()
+        assert len(evs) == 8
+        assert [e["height"] for e in evs] == list(range(12, 20))
+        seqs = [e["seq"] for e in evs]
+        assert seqs == sorted(seqs)
+
+    def test_t_ns_monotonic(self):
+        r = FlightRecorder(size=64)
+        for i in range(32):
+            r.record("step", height=i)
+        ts = [e["t_ns"] for e in r.events()]
+        assert all(a <= b for a, b in zip(ts, ts[1:]))
+
+    def test_since_watermark(self):
+        r = FlightRecorder(size=64)
+        for i in range(10):
+            r.record("step", height=i)
+        snap = r.snapshot()
+        assert snap["next_seq"] == 10 and snap["dropped"] == 0
+        r.record("step", height=10)
+        fresh = r.events(since=snap["next_seq"])
+        assert [e["height"] for e in fresh] == [10]
+
+    def test_disabled_and_nop_record_nothing(self):
+        for r in (FlightRecorder(size=8, enabled=False), NopRecorder()):
+            r.record("step", height=1)
+            assert r.events() == []
+            assert r.snapshot()["enabled"] is False
+
+    def test_record_overhead_budget(self):
+        # contract: < 1 us/event enabled; tripwire at 5 us so CI-host
+        # noise can't flake the suite while a 10x regression still fails
+        r = FlightRecorder(size=4096)
+        n = 50_000
+        t0 = time.perf_counter()
+        for i in range(n):
+            r.record("verify.flush", batch=4, wait_ms=0.2, quantum_ms=0.2)
+        per_event = (time.perf_counter() - t0) / n
+        assert per_event < 5e-6, f"record() took {per_event * 1e6:.2f} us/event"
+
+
+class TestSpanChains:
+    def _chain_events(self, heights, skip=()):
+        r = FlightRecorder(size=1024)
+        for h in heights:
+            for step in ("NewHeight", "NewRound", *tracing.REQUIRED_STEPS):
+                if (h, step) not in skip:
+                    r.record("step", height=h, round=0, step=step)
+        return r.events()
+
+    def test_step_chains_and_complete_heights(self):
+        evs = self._chain_events([5, 6, 7], skip={(6, "Precommit")})
+        chains = tracing.step_chains(evs)
+        assert set(chains) == {5, 6, 7}
+        assert tracing.complete_heights(chains) == [5, 7]
+
+    def test_block_breakdown_medians(self):
+        evs = self._chain_events([1, 2, 3, 4])
+        bd = tracing.block_breakdown(evs)
+        assert bd is not None
+        assert bd["source"] == "flight_recorder"
+        assert bd["blocks"] == 3  # heights 1-3 have a next-height Propose
+        for k in ("propose_ms", "prevote_ms", "precommit_ms", "commit_ms", "block_ms"):
+            assert bd[k] >= 0
+
+    def test_block_breakdown_needs_consecutive_chains(self):
+        assert tracing.block_breakdown(self._chain_events([3])) is None
+        assert tracing.block_breakdown([]) is None
+
+
+class TestRPCRoute:
+    async def test_dump_flight_recorder_route(self):
+        from tendermint_tpu.rpc.core import RPCCore
+
+        class _StubNode:
+            flight_recorder = FlightRecorder(size=32)
+
+        node = _StubNode()
+        node.flight_recorder.record("step", height=1, round=0, step="Propose")
+        core = RPCCore(node)
+        snap = await core.call("dump_flight_recorder")
+        assert snap["enabled"] is True
+        assert snap["events"][0]["kind"] == "step"
+        assert snap["events"][0]["height"] == 1
+        # seq watermark polling: nothing new -> empty
+        again = await core.call("dump_flight_recorder", {"since": snap["next_seq"]})
+        assert again["events"] == []
+
+    async def test_route_survives_node_without_recorder(self):
+        from tendermint_tpu.rpc.core import RPCCore
+
+        snap = await RPCCore(object()).call("dump_flight_recorder")
+        assert snap == {
+            "enabled": False, "size": 0, "next_seq": 0, "dropped": 0, "events": [],
+        }
+
+
+class TestVerifyEngineEvents:
+    async def test_async_batcher_emits_enqueue_and_flush_spans(self):
+        from tendermint_tpu.crypto.batch_verifier import AsyncBatchVerifier, BatchVerifier
+        from tendermint_tpu.crypto.keys import Ed25519PrivKey
+
+        rec = FlightRecorder(size=256)
+        # min_device_batch above any test batch: the host path serves, no
+        # device compile — this test is about the event stream, not JAX
+        bv = BatchVerifier(min_device_batch=1 << 30, recorder=rec)
+        svc = AsyncBatchVerifier(bv)
+        await svc.start()
+        try:
+            k = Ed25519PrivKey.from_secret(b"trace")
+            msg = b"\x08\x02\x11" + bytes(40)
+            assert await svc.verify_one(k.pub_key().bytes(), msg, k.sign(msg))
+        finally:
+            await svc.stop()
+        kinds = [e["kind"] for e in rec.events()]
+        assert "verify.enqueue" in kinds
+        assert "verify.flush" in kinds
+        assert "verify.dispatch" in kinds
+        flush = next(e for e in rec.events() if e["kind"] == "verify.flush")
+        assert flush["batch"] >= 1 and flush["wait_ms"] >= 0
+        dispatch = next(e for e in rec.events() if e["kind"] == "verify.dispatch")
+        assert dispatch["path"] == "host" and dispatch["n"] >= 1
