@@ -522,10 +522,12 @@ def _e2e_breakdown(procs: dict, hop_ms: float) -> str:
             f"propose→commit span chains from node0, same stream as the "
             f"dump_flight_recorder RPC): {cps:.1f} commits/sec; median block "
             f"{rec['block_ms']:.1f} ms = propose {rec['propose_ms']:.1f} ms "
-            f"(proposal + parts gossip on the 5 ms peer-gossip quantum) + "
+            f"(proposal + rarest-first part bursts on event wakeups) + "
             f"prevote {rec['prevote_ms']:.1f} ms + precommit "
-            f"{rec['precommit_ms']:.1f} ms (vote rounds; serial C host verify, "
-            f"batches of 4 < min_device_batch) + commit→next-height "
+            f"{rec['precommit_ms']:.1f} ms (vote rounds: event-driven "
+            f"vote_batch gossip — wakeups bound latency, not the "
+            f"peer-gossip tick; serial C host verify, batches of 4 < "
+            f"min_device_batch) + commit→next-height "
             f"{rec['commit_ms']:.1f} ms (block exec/store + new-height "
             f"turnaround). Sparse-regime adaptive vote-flush hop measures "
             f"{hop_ms:.2f} ms, over {procs.get('blocks', '?')} blocks in "

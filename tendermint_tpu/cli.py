@@ -64,7 +64,16 @@ def cmd_init(args) -> int:
 
 def cmd_run(args) -> int:
     """commands/run_node.go:97 — run a node until SIGINT/SIGTERM."""
+    import gc
+
     from .node import default_new_node
+
+    # Long-running node: the default gen0 threshold (700 allocations) fires
+    # collections mid-consensus-step thousands of times per second under
+    # message churn; ~ms pauses across co-located validators compound into
+    # block-time jitter.  Collect far less often — the working set is
+    # mostly acyclic (bytes/dataclasses), so gen0 pressure is cheap to defer.
+    gc.set_threshold(50_000, 50, 25)
 
     cfg = _load_cfg(args.home)
     if args.proxy_app:

@@ -84,6 +84,10 @@ class MempoolConfig:
     cache_size: int = 10000
     max_tx_bytes: int = 1048576
     keep_invalid_txs_in_cache: bool = False
+    # Batch-verify ed25519 signed-tx envelopes (mempool.SIGNED_TX_PREFIX)
+    # through the shared verify engine BEFORE the ABCI round-trip; a burst
+    # of CheckTx calls coalesces into one device/host batch.
+    sig_precheck: bool = False
 
     def as_dict(self) -> dict:
         return {
@@ -93,6 +97,7 @@ class MempoolConfig:
             "cache_size": self.cache_size,
             "max_tx_bytes": self.max_tx_bytes,
             "keep_invalid_txs_in_cache": self.keep_invalid_txs_in_cache,
+            "sig_precheck": self.sig_precheck,
         }
 
 
@@ -117,6 +122,17 @@ class ConsensusConfig:
     create_empty_blocks_interval: float = 0.0
     peer_gossip_sleep_duration: float = 0.1
     peer_query_maj23_sleep_duration: float = 2.0
+    # Event-driven batched gossip (no reference counterpart; the reference
+    # polls one vote / one block part per peer_gossip_sleep_duration tick).
+    # gossip_vote_batch advertises the vote_batch wire capability in
+    # NodeInfo and sends byte-capped vote batches to peers that advertise
+    # it back; peers that don't (or a node with the knob off) get the
+    # reference's single-vote messages, so mixed-version nets converge.
+    gossip_vote_batch: bool = True
+    gossip_vote_batch_bytes: int = 65536  # byte cap per vote_batch frame
+    # Flow-control window: block parts transmitted per gossip wakeup
+    # (rarest-first across peers instead of pick_random).
+    gossip_part_burst: int = 8
     # Propose-side clock sanity (seconds): prevote nil on proposals whose
     # header time is further than this past local now — the node-side twin
     # of lite2's max_clock_drift (defaultMaxClockDrift, 10 s).  0 disables.
@@ -243,6 +259,10 @@ class Config:
             raise ValueError(f"unknown fastsync version {self.fast_sync.version!r}")
         if self.instrumentation.flight_recorder_size < 1:
             raise ValueError("instrumentation.flight_recorder_size must be >= 1")
+        if self.consensus.gossip_part_burst < 1:
+            raise ValueError("consensus.gossip_part_burst must be >= 1")
+        if self.consensus.gossip_vote_batch_bytes < 1024:
+            raise ValueError("consensus.gossip_vote_batch_bytes must be >= 1024")
 
 
 def default_config(home: str = "~/.tendermint_tpu") -> Config:

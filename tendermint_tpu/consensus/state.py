@@ -123,6 +123,10 @@ class ConsensusState(Service):
         self.on_vote = []  # callables(Vote)
         self.on_valid_block = []  # callables(RoundState)
         self.on_proposal_heartbeat = []
+        # gossip wakeup hooks: the reactor's event-driven gossip routines
+        # wait on these instead of polling every peer_gossip_sleep tick
+        self.on_proposal = []  # callables(RoundState) — a proposal landed
+        self.on_new_block_part = []  # callables(RoundState) — a part landed
 
         # overridable behaviours for byzantine tests
         self.decide_proposal = self.default_decide_proposal
@@ -185,12 +189,18 @@ class ConsensusState(Service):
         for t in (self._receive_task, self._ticker_pump, self._txs_pump):
             if t is not None and not t.done():
                 t.cancel()
-                try:
-                    await t
-                except asyncio.CancelledError:
-                    pass
+                # asyncio.wait, not wait_for: a task that survives its
+                # cancel (e.g. 3.10 wait_for swallowing it mid-sign,
+                # bpo-42130) must not strangle node teardown — after the
+                # grace window, proceed; Service.stop's cancel pass covers
+                # the stragglers
+                await asyncio.wait({t}, timeout=2.0)
         await self.timeout_ticker.stop()
-        self.wal.close()
+        # A straggler receive task past the grace window may still be
+        # mid-message; closing the WAL under it would lose the tail it is
+        # writing.  Its own finally closes the WAL when it unwinds.
+        if self._receive_task is None or self._receive_task.done():
+            self.wal.close()
 
     async def wait_done(self) -> None:
         await self._done.wait()
@@ -290,9 +300,18 @@ class ConsensusState(Service):
         kind, peer_id = mi["type"], mi.get("peer_id", "")
         try:
             if kind == "proposal":
+                had = self.rs.proposal is not None
                 await self.set_proposal(mi["proposal"])
+                if not had and self.rs.proposal is not None:
+                    for cb in self.on_proposal:
+                        cb(self.rs)
             elif kind == "block_part":
-                await self._add_proposal_block_part(mi["height"], mi["round"], mi["part"], peer_id)
+                added = await self._add_proposal_block_part(
+                    mi["height"], mi["round"], mi["part"], peer_id
+                )
+                if added:
+                    for cb in self.on_new_block_part:
+                        cb(self.rs)
             elif kind == "vote":
                 await self._try_add_vote(mi["vote"], peer_id, mi.get("verified", False))
         except ErrVoteConflictingVotes:

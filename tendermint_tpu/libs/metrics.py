@@ -66,10 +66,11 @@ class ConsensusMetrics:
                 "byzantine_validators", "byzantine_validators_power",
                 "block_interval_seconds", "num_txs", "block_size_bytes",
                 "total_txs", "committed_height", "fast_syncing", "block_parts",
+                "gossip_wakeups", "vote_batch_size", "parts_per_burst",
             ):
                 setattr(self, name, _NOP)
             return
-        from prometheus_client import Gauge
+        from prometheus_client import Gauge, Histogram
 
         sub = "consensus"
         kw = dict(namespace=NAMESPACE, subsystem=sub, registry=registry,
@@ -109,6 +110,24 @@ class ConsensusMetrics:
             namespace=NAMESPACE, subsystem=sub, registry=registry,
             labelnames=("chain_id", "peer_id"),
         )
+        # Event-driven gossip series (no reference counterpart — the
+        # reference's gossip is a poll loop with nothing to count).
+        # Counter-like Gauge, same convention as above (no `_total` rename).
+        self.gossip_wakeups = g(
+            "gossip_wakeups",
+            "Gossip routine wakeups triggered by consensus events "
+            "(vs the fixed-sleep fallback).",
+        )
+        self.vote_batch_size = Histogram(
+            "vote_batch_size", "Votes per sent vote_batch gossip frame.",
+            namespace=NAMESPACE, subsystem=sub, registry=registry,
+            labelnames=("chain_id",), buckets=[2**i for i in range(0, 14)],
+        ).labels(chain_id=chain_id)
+        self.parts_per_burst = Histogram(
+            "parts_per_burst", "Block parts sent per gossip wakeup burst.",
+            namespace=NAMESPACE, subsystem=sub, registry=registry,
+            labelnames=("chain_id",), buckets=[1, 2, 4, 8, 16, 32, 64],
+        ).labels(chain_id=chain_id)
 
 
 class P2PMetrics:

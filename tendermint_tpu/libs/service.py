@@ -90,15 +90,18 @@ class Service:
             # a recv routine erroring out — must not strangle its own
             # unwind, and awaiting yourself never completes).
             current = asyncio.current_task()
-            for t in self._tasks:
-                if t is not current:
-                    t.cancel()
-            for t in list(self._tasks):
-                if t is current:
-                    continue
+            others = [t for t in self._tasks if t is not current]
+            for t in others:
+                t.cancel()
+            if others:
+                # asyncio.wait, not per-task wait_for: wait_for's timeout
+                # path ends in an UNBOUNDED _cancel_and_wait — one task
+                # that refuses its cancel (3.10 wait_for can swallow one,
+                # bpo-42130) would hang the whole shutdown tree forever.
+                # One collective bounded wait; stragglers are abandoned.
                 try:
-                    await asyncio.wait_for(t, self.STOP_TIMEOUT)
-                except (asyncio.CancelledError, asyncio.TimeoutError, Exception):
+                    await asyncio.wait(others, timeout=self.STOP_TIMEOUT)
+                except Exception:
                     pass
             self._tasks.clear()
             if self._quit is not None:

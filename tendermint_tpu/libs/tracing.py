@@ -16,11 +16,18 @@ Event kinds currently emitted:
     commit            height, txs              block finalized
   verify engine (crypto/batch_verifier.py):
     verify.enqueue    pending                  vote entered the batcher
+    verify.enqueue_batch  n, pending           whole vote_batch entered as one arrival
     verify.flush      batch, wait_ms, quantum_ms   batcher coalesced a flush
     verify.dispatch   n, bucket, path, host_prep_ms, device_ms
     verify.bucket_compile  bucket, ms, ok      background XLA compile done
     verify.chunked    selected, rtt_ms, prep_ms    RTT-probe decision
     verify.table      hit, n                   TableCache lookup
+  gossip (consensus/reactor.py, event-driven path):
+    gossip.wakeup     peer                     routine woken by an event (not the
+                                               fallback sleep cap)
+    gossip.votes      mode, n, bytes           vote send: mode batch|single
+    gossip.vote_batch_recv  n                  decoded batch entered the verifier
+    gossip.part_burst n[, catchup]             block parts sent in one burst
 
 Events are flat dicts: {"seq", "t_ns", "kind", **fields}.  `t_ns` is
 time.monotonic_ns() — deltas are meaningful, wall-clock is not.

@@ -23,6 +23,39 @@ class MempoolError(Exception):
     pass
 
 
+# -- signed-tx envelope (mempool.sig_precheck) -------------------------------
+#
+# Optional ingress filter: ed25519-signed tx envelopes are batch-verified
+# through the shared verify engine BEFORE the ABCI round-trip, so a burst
+# of CheckTx calls coalesces into one device/host batch instead of the app
+# paying per-tx signature checks (the committee-consensus scaling wall of
+# arXiv:2302.00418, applied to mempool ingress).  Envelope layout:
+#   SIGNED_TX_PREFIX ‖ pubkey(32) ‖ signature(64) ‖ payload
+# with the signature over SIGNED_TX_DOMAIN ‖ payload.
+
+SIGNED_TX_PREFIX = b"\x00sgtx1"
+SIGNED_TX_DOMAIN = b"tendermint_tpu/signed-tx\x00"
+_SIGNED_TX_HEADER = len(SIGNED_TX_PREFIX) + 32 + 64
+
+
+def make_signed_tx(priv_key, payload: bytes) -> bytes:
+    """Wrap a payload in a signed-tx envelope (test/client helper)."""
+    sig = priv_key.sign(SIGNED_TX_DOMAIN + payload)
+    return SIGNED_TX_PREFIX + priv_key.pub_key().bytes() + sig + payload
+
+
+def parse_signed_tx(tx: bytes) -> Optional[tuple]:
+    """(pubkey, sign_bytes, signature, payload) or None if not an
+    envelope / malformed."""
+    if not tx.startswith(SIGNED_TX_PREFIX) or len(tx) < _SIGNED_TX_HEADER:
+        return None
+    off = len(SIGNED_TX_PREFIX)
+    pubkey = tx[off : off + 32]
+    sig = tx[off + 32 : off + 96]
+    payload = tx[_SIGNED_TX_HEADER:]
+    return pubkey, SIGNED_TX_DOMAIN + payload, sig, payload
+
+
 class TxInCacheError(MempoolError):
     """mempool/errors.go ErrTxInCache."""
 
@@ -64,6 +97,10 @@ class TxCache:
         self._map[key] = None
         return True
 
+    def contains(self, tx: bytes) -> bool:
+        """Read-only membership (no LRU touch)."""
+        return tx_hash(tx) in self._map
+
     def remove(self, tx: bytes) -> None:
         self._map.pop(tx_hash(tx), None)
 
@@ -85,6 +122,11 @@ class Mempool:
         self.max_tx_bytes = cfg.get("max_tx_bytes", 1024 * 1024)
         self.recheck = cfg.get("recheck", True)
         self.keep_invalid_txs_in_cache = cfg.get("keep_invalid_txs_in_cache", False)
+        self.sig_precheck = cfg.get("sig_precheck", False)
+        # AsyncBatchVerifier (or anything with verify_one) — the node wires
+        # its shared engine in when sig_precheck is on; None falls back to
+        # the serial host path per tx
+        self.sig_verifier = None
         self.cache = TxCache(cfg.get("cache_size", 10000))
         self.height = height
         self.txs: "Dict[bytes, MempoolTx]" = {}  # insertion-ordered
@@ -161,6 +203,24 @@ class Mempool:
             err = self.pre_check(tx)
             if err:
                 raise MempoolError(f"pre-check failed: {err}")
+        if (
+            self.sig_precheck
+            and tx.startswith(SIGNED_TX_PREFIX)
+            # a cached tx was already verified (or is a tracked invalid):
+            # re-verifying every gossiped duplicate would invert the
+            # feature's point — let the cache-dedup below reject it free
+            and not self.cache.contains(tx)
+        ):
+            # BEFORE the app round-trip — rejecting here is what lets the
+            # engine batch a burst of envelopes in one flush
+            if not await self._verify_tx_sig(tx):
+                # cache the rejection: the key is the hash of the FULL tx
+                # bytes (pubkey+sig+payload), so these exact bytes can
+                # never become valid — without this, resubmitting the same
+                # bad envelope buys a fresh verify every time
+                self.cache.push(tx)
+                self.metrics.failed_txs.inc()
+                raise MempoolError("invalid tx signature")
         if not self.cache.push(tx):
             # record the new sender for an existing tx (clist_mempool.go:239)
             existing = self.txs.get(tx_hash(tx))
@@ -191,6 +251,20 @@ class Mempool:
             self.metrics.failed_txs.inc()
             self.log.debug("rejected bad transaction", tx=tx_hash(tx).hex()[:16], code=res.code)
         return res
+
+    async def _verify_tx_sig(self, tx: bytes) -> bool:
+        parsed = parse_signed_tx(tx)
+        if parsed is None:
+            return False  # carries the prefix but is structurally broken
+        pubkey, sign_bytes, sig, _ = parsed
+        if self.sig_verifier is not None:
+            try:
+                return bool(await self.sig_verifier.verify_one(pubkey, sign_bytes, sig))
+            except Exception:
+                return False
+        from .crypto import batch as batch_hook
+
+        return bool(batch_hook.host_batch_verify([pubkey], [sign_bytes], [sig])[0])
 
     # -- egress ------------------------------------------------------------
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:

@@ -178,6 +178,10 @@ class Node(Service):
             self.mempool.init_wal(cfg.mempool_wal_dir())
         if cfg.consensus.wait_for_txs():
             self.mempool.enable_txs_available()
+        if cfg.mempool.sig_precheck and self.async_verifier is not None:
+            # signed-tx envelopes batch-verify through the SAME engine as
+            # consensus votes — one flusher coalesces both ingress streams
+            self.mempool.sig_verifier = self.async_verifier
 
         # evidence pool
         from .evidence import EvidencePool
@@ -235,11 +239,19 @@ class Node(Service):
             from .mempool_reactor import MempoolReactor
             from .p2p import NodeInfo, NodeKey, Switch, Transport
 
+            from .p2p.node_info import GOSSIP_BATCH_VERSION
+
             self.node_key = NodeKey.load_or_gen(cfg.node_key_file())
             node_info = NodeInfo(
                 node_id=self.node_key.id,
                 network=self.genesis_doc.chain_id,
                 moniker=cfg.base.moniker,
+                # advertise the vote_batch wire capability only when the
+                # knob is on; peers fall back to single-vote gossip for
+                # nodes advertising 0 (mixed-version convergence)
+                gossip_version=(
+                    GOSSIP_BATCH_VERSION if cfg.consensus.gossip_vote_batch else 0
+                ),
             )
             transport = Transport(self.node_key, node_info)
             fuzz_config = None

@@ -14,6 +14,12 @@ from ..version import BLOCK_PROTOCOL, P2P_PROTOCOL, SOFTWARE_VERSION
 
 MAX_NUM_CHANNELS = 16
 
+# Consensus-gossip capability level advertised in NodeInfo.  0 = legacy
+# single-vote gossip (and what a peer whose handshake dict predates the
+# field resolves to, via from_dict's unknown-field tolerance); 1 = the
+# peer decodes byte-capped `vote_batch` frames on the VOTE channel.
+GOSSIP_BATCH_VERSION = 1
+
 
 @dataclass
 class NodeInfo:
@@ -27,10 +33,19 @@ class NodeInfo:
     moniker: str = "node"
     tx_index: str = "on"
     rpc_address: str = ""
+    # Deliberately defaults to 0 (legacy): a NodeInfo deserialized from an
+    # older peer lacks the field entirely, and the conservative default is
+    # what keeps mixed-version nets converging.  The node assembly sets it
+    # to GOSSIP_BATCH_VERSION when consensus.gossip_vote_batch is on.
+    gossip_version: int = 0
 
     def validate_basic(self) -> None:
         if not self.node_id:
             raise ValueError("empty node id")
+        # wire field, attacker-suppliable: a non-int here would TypeError
+        # inside the gossip routines' capability comparison and kill them
+        if not isinstance(self.gossip_version, int) or isinstance(self.gossip_version, bool):
+            raise ValueError("gossip_version must be an integer")
         if len(self.channels) > MAX_NUM_CHANNELS:
             raise ValueError(f"too many channels: {len(self.channels)}")
         if len(set(self.channels)) != len(self.channels):
@@ -59,6 +74,7 @@ class NodeInfo:
             "moniker": self.moniker,
             "tx_index": self.tx_index,
             "rpc_address": self.rpc_address,
+            "gossip_version": self.gossip_version,
         }
 
     @classmethod

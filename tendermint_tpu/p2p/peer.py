@@ -50,6 +50,16 @@ class Peer(Service):
     def id(self) -> str:
         return self.node_info.node_id
 
+    @property
+    def gossip_version(self) -> int:
+        """Negotiated consensus-gossip capability (p2p/node_info.py
+        GOSSIP_BATCH_VERSION); 0 for peers that never advertised one.
+        Defensive int-coerce: the comparison sites run inside gossip
+        routines, where a TypeError would kill the task and wedge the
+        peer (validate_basic rejects non-ints at handshake too)."""
+        v = getattr(self.node_info, "gossip_version", 0)
+        return v if isinstance(v, int) and not isinstance(v, bool) else 0
+
     async def on_start(self) -> None:
         await self.mconn.start()
 

@@ -182,7 +182,22 @@ class SignerClient(PrivValidator, Service):
                 raise RemoteSignerError("no signer connection")
             conn = self._conn
             await conn.send(msg)
-            resp = await asyncio.wait_for(conn.recv(), self.timeout)
+            # NOT asyncio.wait_for: on 3.10 a caller cancellation arriving
+            # in the same loop tick as the reply is SWALLOWED by wait_for
+            # (bpo-42130) — the consensus receive task then survives its
+            # own cancel mid-sign and node stop wedges on it (observed
+            # under suite load).  asyncio.wait never eats the caller's
+            # CancelledError; the recv task is reaped on every exit path.
+            recv_task = asyncio.ensure_future(conn.recv())
+            try:
+                done, _ = await asyncio.wait({recv_task}, timeout=self.timeout)
+            except asyncio.CancelledError:
+                recv_task.cancel()
+                raise
+            if not done:
+                recv_task.cancel()
+                raise RemoteSignerError(f"signer request timed out after {self.timeout}s")
+            resp = recv_task.result()
         if resp.get("t") == "error":
             raise RemoteSignerError(resp.get("err", "unknown remote signer error"))
         return resp

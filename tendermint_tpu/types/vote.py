@@ -39,6 +39,20 @@ class Vote:
     validator_address: bytes = b""
     validator_index: int = -1
     signature: bytes = b""
+    # Encode-once caches (gossip hot path): a signed vote is immutable, so
+    # its canonical msgpack bytes are computed once and reused across every
+    # peer send instead of re-encoded per peer per tick.  Excluded from
+    # equality/repr; never serialized (to_dict does not emit them).
+    _wire: Optional[bytes] = field(default=None, repr=False, compare=False)
+    _legacy_frame: Optional[bytes] = field(default=None, repr=False, compare=False)
+
+    def wire(self) -> bytes:
+        """Canonical tagged msgpack encoding (codec '@t' form), cached.
+        vote_batch frames embed these bytes verbatim, so a batch to N peers
+        encodes each vote once, not N times."""
+        if self._wire is None:
+            self._wire = codec.dumps(self)
+        return self._wire
 
     def sign_bytes(self, chain_id: str) -> bytes:
         return canonical.canonical_vote_sign_bytes(

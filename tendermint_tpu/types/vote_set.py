@@ -215,6 +215,18 @@ class VoteSet:
             return None
         return self.votes[val_index]
 
+    def missing_votes(self, peer_bits: Optional[BitArray]) -> List[Vote]:
+        """Every canonical vote we hold that `peer_bits` says the peer
+        lacks, in validator-index order — the send set of one batched
+        gossip wakeup (vs the reference's one-random-vote-per-tick
+        PickSendVote, reactor.go:1036)."""
+        missing = self.votes_bit_array.sub(peer_bits) if peer_bits is not None else self.votes_bit_array
+        return [
+            v
+            for i in missing.true_indices()
+            if (v := self.votes[i]) is not None
+        ]
+
     def get_by_address(self, address: bytes) -> Optional[Vote]:
         idx, val = self.val_set.get_by_address(address)
         if val is None:

@@ -1,0 +1,489 @@
+"""Event-driven batched gossip tests.
+
+The reference gossips one vote / one block part per peer per
+`peer_gossip_sleep_duration` tick (consensus/reactor.go:467/606).  This
+layer replaces the pacing with per-peer wakeup events and byte-capped
+`vote_batch` frames; these tests pin the three contracts that matter:
+
+1. latency — a vote created on node A lands in node B's vote set well
+   under the gossip sleep (the wakeup path, not the tick, carries it);
+2. batch shape — a received vote_batch reaches the AsyncBatchVerifier as
+   exactly ONE flush (one host-prep pass, the engine's batch shape);
+3. wire compatibility — a batched node and a legacy single-vote node
+   (knob forced off, NodeInfo advertises gossip_version 0) commit blocks
+   together, with the fallback path demonstrably exercised.
+"""
+
+import asyncio
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from tendermint_tpu.config import ConsensusConfig, test_config as make_test_cfg
+from tendermint_tpu.consensus.reactor import (
+    VOTE_CHANNEL,
+    ConsensusReactor,
+    PeerRoundState,
+    _enc,
+)
+from tendermint_tpu.consensus.types import HeightVoteSet, RoundState
+from tendermint_tpu.crypto.batch_verifier import AsyncBatchVerifier, BatchVerifier
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.encoding import codec
+from tendermint_tpu.libs import tracing
+from tendermint_tpu.libs.bitarray import BitArray
+from tendermint_tpu.libs.metrics import ConsensusMetrics
+from tendermint_tpu.node import Node
+from tendermint_tpu.p2p.node_info import GOSSIP_BATCH_VERSION, NodeInfo
+from tendermint_tpu.types import (
+    BlockID,
+    GenesisDoc,
+    GenesisValidator,
+    MockPV,
+    PartSetHeader,
+    Validator,
+    ValidatorSet,
+    Vote,
+)
+from tendermint_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+
+from tendermint_tpu.types.params import BlockParams as _BP, ConsensusParams as _CP
+
+_FAST_IOTA_PARAMS = _CP(block=_BP(time_iota_ms=1))
+
+CHAIN_ID = "gossip-test-chain"
+
+
+# ---------------------------------------------------------------------------
+# unit-level fixtures
+# ---------------------------------------------------------------------------
+
+
+class _CountingVerifier(BatchVerifier):
+    """Host-path verifier that counts device/host dispatches — one call ==
+    one engine flush reached the verify kernel."""
+
+    def __init__(self):
+        super().__init__(min_device_batch=10**9)  # always the host path
+        self.calls = []
+
+    def start_warmup(self):
+        # the host path never dispatches to the device: skip the background
+        # bucket compile thread (pure core contention on the CI container)
+        return self
+
+    def verify(self, pubkeys, msgs, sigs):
+        self.calls.append(len(sigs))
+        return super().verify(pubkeys, msgs, sigs)
+
+
+class _FakeSwitch:
+    def __init__(self):
+        self.stopped = []
+
+    async def stop_peer_for_error(self, peer, reason):
+        self.stopped.append((peer.id, reason))
+
+
+class _FakeCS:
+    """The slice of ConsensusState the reactor's vote-receive path uses."""
+
+    def __init__(self, vset, height=5):
+        self.config = ConsensusConfig()
+        self.rs = RoundState(
+            height=height,
+            validators=vset,
+            votes=HeightVoteSet(CHAIN_ID, height, vset),
+            last_validators=None,
+        )
+        self.sm_state = SimpleNamespace(chain_id=CHAIN_ID)
+        self.on_new_round_step = []
+        self.on_vote = []
+        self.on_valid_block = []
+        self.on_proposal = []
+        self.on_new_block_part = []
+        self.metrics = ConsensusMetrics()
+        self.recorder = tracing.NOP
+        self.added = []
+
+    async def add_vote_input(self, vote, peer_id="", verified=False):
+        self.added.append((vote, peer_id, verified))
+
+
+def _vset_and_votes(n=4, height=5, vote_type=PREVOTE_TYPE):
+    pvs = [MockPV() for _ in range(n)]
+    vset = ValidatorSet([Validator.new(pv.get_pub_key(), 10) for pv in pvs])
+    pvs.sort(key=lambda pv: pv.address())
+    votes = []
+    for pv in pvs:
+        i, _ = vset.get_by_address(pv.address())
+        v = Vote(
+            type=vote_type, height=height, round=0, block_id=BlockID(),
+            timestamp_ns=1, validator_address=pv.address(), validator_index=i,
+        )
+        pv.sign_vote(CHAIN_ID, v)
+        votes.append(v)
+    return vset, votes
+
+
+def _batch_msg(votes):
+    return _enc("vote_batch", {"votes": [v.wire() for v in votes]})
+
+
+class TestVoteWire:
+    def test_wire_encode_once_and_roundtrip(self):
+        _, votes = _vset_and_votes(2)
+        v = votes[0]
+        w1 = v.wire()
+        assert v.wire() is w1  # cached, not re-encoded
+        back = codec.loads(w1)
+        assert isinstance(back, Vote)
+        assert back == v
+
+    def test_node_info_defaults_legacy_for_old_peers(self):
+        # a handshake dict from a node predating the field must resolve to
+        # the conservative legacy capability, not the batched one
+        old = NodeInfo.from_dict({"node_id": "ab" * 20})
+        assert old.gossip_version == 0
+        new = NodeInfo.from_dict({"node_id": "ab" * 20, "gossip_version": 1})
+        assert new.gossip_version == GOSSIP_BATCH_VERSION
+
+
+class TestVerifyMany:
+    async def test_single_flush_for_whole_batch(self):
+        cv = _CountingVerifier()
+        svc = AsyncBatchVerifier(cv)
+        await svc.start()
+        try:
+            keys = [Ed25519PrivKey.from_secret(b"vm%d" % i) for i in range(50)]
+            msgs = [b"payload-%d" % i for i in range(50)]
+            items = [
+                (k.pub_key().bytes(), m, k.sign(m)) for k, m in zip(keys, msgs)
+            ]
+            items[7] = (items[7][0], items[7][1], bytes(64))  # one bad sig
+            results = await asyncio.gather(*svc.verify_many(items))
+            assert len(cv.calls) == 1 and cv.calls[0] == 50
+            assert results[7] is False
+            assert all(r for i, r in enumerate(results) if i != 7)
+        finally:
+            await svc.stop()
+
+
+class TestVoteBatchReceive:
+    async def test_batch_is_one_engine_flush_and_lands_verified(self):
+        vset, votes = _vset_and_votes(4)
+        cs = _FakeCS(vset)
+        cv = _CountingVerifier()
+        svc = AsyncBatchVerifier(cv)
+        await svc.start()
+        try:
+            reactor = ConsensusReactor(cs, async_verifier=svc)
+            reactor.switch = _FakeSwitch()
+            peer = SimpleNamespace(id="batch-peer-0000", gossip_version=1)
+            reactor.peer_states[peer.id] = PeerRoundState()
+            await reactor.receive(VOTE_CHANNEL, peer, _batch_msg(votes))
+            assert len(cv.calls) == 1 and cv.calls[0] == len(votes), (
+                "a vote_batch must reach the engine as exactly one flush"
+            )
+            assert len(cs.added) == len(votes)
+            assert all(verified for _, _, verified in cs.added)
+            assert reactor.switch.stopped == []
+        finally:
+            await svc.stop()
+
+    async def test_bad_signature_in_batch_stops_peer(self):
+        vset, votes = _vset_and_votes(4)
+        votes[2].signature = bytes(64)
+        cs = _FakeCS(vset)
+        svc = AsyncBatchVerifier(_CountingVerifier())
+        await svc.start()
+        try:
+            reactor = ConsensusReactor(cs, async_verifier=svc)
+            reactor.switch = _FakeSwitch()
+            peer = SimpleNamespace(id="badsig-peer-000", gossip_version=1)
+            reactor.peer_states[peer.id] = PeerRoundState()
+            await reactor.receive(VOTE_CHANNEL, peer, _batch_msg(votes))
+            assert reactor.switch.stopped, "invalid batch signature must stop the peer"
+            assert cs.added == []
+        finally:
+            await svc.stop()
+
+    async def test_oversized_batch_stops_peer(self):
+        vset, votes = _vset_and_votes(1)
+        cs = _FakeCS(vset)
+        reactor = ConsensusReactor(cs, async_verifier=None)
+        reactor.switch = _FakeSwitch()
+        peer = SimpleNamespace(id="flood-peer-0000", gossip_version=1)
+        reactor.peer_states[peer.id] = PeerRoundState()
+        msg = _enc("vote_batch", {"votes": [votes[0].wire()] * 16385})
+        await reactor.receive(VOTE_CHANNEL, peer, msg)
+        assert reactor.switch.stopped
+
+
+class TestRarestFirst:
+    def _reactor(self, vset):
+        return ConsensusReactor(_FakeCS(vset))
+
+    def test_pick_parts_prefers_parts_fewest_peers_hold(self):
+        vset, _ = _vset_and_votes(2)
+        reactor = self._reactor(vset)
+        header = PartSetHeader(4, b"\x01" * 32)
+        ps = PeerRoundState()
+        ps.proposal_block_parts_header = header
+        ps.proposal_block_parts = BitArray(4)
+        other = PeerRoundState()
+        other.proposal_block_parts_header = header
+        other.proposal_block_parts = BitArray.from_indices(4, [0, 1])
+        reactor.peer_states = {"a": ps, "b": other}
+        missing = BitArray.from_indices(4, range(4))
+        got = reactor._pick_parts(missing, ps, 2)
+        # parts 2 and 3 are held by no other peer: they go first
+        assert set(got) == {2, 3}
+        assert reactor._pick_parts(missing, ps, 10) != []  # window respected
+        assert len(reactor._pick_parts(missing, ps, 3)) == 3
+
+    def test_pick_parts_ignores_mismatched_headers(self):
+        vset, _ = _vset_and_votes(2)
+        reactor = self._reactor(vset)
+        ps = PeerRoundState()
+        ps.proposal_block_parts_header = PartSetHeader(2, b"\x01" * 32)
+        other = PeerRoundState()
+        other.proposal_block_parts_header = PartSetHeader(2, b"\x02" * 32)
+        other.proposal_block_parts = BitArray.from_indices(2, [0])
+        reactor.peer_states = {"a": ps, "b": other}
+        missing = BitArray.from_indices(2, range(2))
+        assert len(reactor._pick_parts(missing, ps, 2)) == 2
+
+
+class TestMaj23Dedupe:
+    async def test_identical_claim_sent_once_then_expires(self):
+        vset, _ = _vset_and_votes(2)
+        cs = _FakeCS(vset)
+        reactor = ConsensusReactor(cs)
+        sent = []
+
+        class _Peer:
+            id = "maj23-peer-0000"
+
+            async def send(self, chan, msg):
+                sent.append((chan, msg))
+                return True
+
+        peer, ps = _Peer(), PeerRoundState()
+        bid = BlockID(b"\x05" * 32, PartSetHeader(1, b"\x06" * 32))
+        await reactor._maybe_send_maj23(peer, ps, 5, 0, PREVOTE_TYPE, bid)
+        await reactor._maybe_send_maj23(peer, ps, 5, 0, PREVOTE_TYPE, bid)
+        assert len(sent) == 1, "identical maj23 claim must not be re-sent"
+        # a different blockID is a different claim
+        bid2 = BlockID(b"\x07" * 32, PartSetHeader(1, b"\x08" * 32))
+        await reactor._maybe_send_maj23(peer, ps, 5, 0, PREVOTE_TYPE, bid2)
+        assert len(sent) == 2
+        # entries expire so the VoteSetBits repair can re-fire
+        key = (5, 0, PREVOTE_TYPE, bid.key())
+        ps.maj23_sent[key] -= 10 * cs.config.peer_query_maj23_sleep_duration + 1
+        await reactor._maybe_send_maj23(peer, ps, 5, 0, PREVOTE_TYPE, bid)
+        assert len(sent) == 3
+        # peer height change clears the table
+        ps.apply_new_round_step({"height": 6, "round": 0, "step": 1})
+        assert ps.maj23_sent == {}
+
+
+# ---------------------------------------------------------------------------
+# live-net tests
+# ---------------------------------------------------------------------------
+
+
+def _gen(pvs):
+    return GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
+        consensus_params=_FAST_IOTA_PARAMS,
+    )
+
+
+async def _make_net(tmp_path, n, name="g", mutate_cfg=None):
+    pvs = sorted([MockPV() for _ in range(n)], key=lambda pv: pv.address())
+    gen = _gen(pvs)
+    nodes = []
+    for i, pv in enumerate(pvs):
+        cfg = make_test_cfg(str(tmp_path / f"{name}{i}"))
+        cfg.rpc.laddr = ""
+        cfg.base.db_backend = "memdb"
+        cfg.p2p.laddr = "127.0.0.1:0"
+        cfg.consensus.skip_timeout_commit = False
+        cfg.consensus.timeout_commit = 0.1
+        if mutate_cfg is not None:
+            mutate_cfg(i, cfg)
+        nodes.append(Node(cfg, gen, priv_validator=pv, db_backend="memdb"))
+    for node in nodes:
+        await node.start()
+    for i in range(n):
+        for j in range(i + 1, n):
+            addr = f"{nodes[j].node_key.id}@{nodes[j].switch.transport.listen_addr}"
+            await nodes[i].switch.dial_peer(addr)
+    return nodes, pvs
+
+
+async def _stop_net(nodes):
+    for node in nodes:
+        if node.is_running:
+            await node.stop()
+
+
+async def _wait_all_height(nodes, h, timeout=45.0):
+    async def _wait():
+        while not all(n.block_store.height() >= h for n in nodes):
+            await asyncio.sleep(0.02)
+
+    await asyncio.wait_for(_wait(), timeout)
+
+
+class TestEventDrivenLatency:
+    async def test_vote_lands_well_under_gossip_sleep(self, tmp_path):
+        """Regression for the tentpole claim: with the polling tick cranked
+        to 1.5 s, a vote signed on node A must land in node B's vote set in
+        a small fraction of that — only the event wakeups can carry it."""
+        SLEEP = 1.5
+
+        def slow_tick(i, cfg):
+            cfg.consensus.peer_gossip_sleep_duration = SLEEP
+
+        nodes, pvs = await _make_net(tmp_path, 2, mutate_cfg=slow_tick)
+        try:
+            addr_a = pvs[0].address()
+            t_signed, t_seen = {}, {}
+
+            def on_a(vote):
+                if vote.validator_address == addr_a and vote.type == PREVOTE_TYPE:
+                    t_signed.setdefault((vote.height, vote.round), time.perf_counter())
+
+            def on_b(vote):
+                if vote.validator_address == addr_a and vote.type == PREVOTE_TYPE:
+                    t_seen.setdefault((vote.height, vote.round), time.perf_counter())
+
+            # node0 signs with pvs[0]; on_vote fires when a vote is ADDED
+            # to the node's own sets — "lands in the vote set", literally
+            nodes[0].consensus.on_vote.append(on_a)
+            nodes[1].consensus.on_vote.append(on_b)
+
+            await _wait_all_height(nodes, 3)
+            common = sorted(set(t_signed) & set(t_seen))
+            assert len(common) >= 2, f"no propagated votes measured: {common}"
+            deltas = sorted(t_seen[k] - t_signed[k] for k in common)
+            median = deltas[len(deltas) // 2]
+            assert median < SLEEP / 3, (
+                f"vote propagation {median * 1000:.0f} ms is not meaningfully "
+                f"under the {SLEEP * 1000:.0f} ms gossip tick — event wakeups dead?"
+            )
+            # and the batched wire path actually carried votes
+            evs = nodes[0].flight_recorder.events()
+            modes = {e.get("mode") for e in evs if e["kind"] == "gossip.votes"}
+            assert "batch" in modes, "no vote_batch frames sent on a batched net"
+            assert any(e["kind"] == "gossip.wakeup" for e in evs)
+        finally:
+            await _stop_net(nodes)
+
+
+class TestMixedVersionInterop:
+    async def test_batched_and_legacy_nodes_commit_together(self, tmp_path):
+        """One node with gossip_vote_batch forced off (advertises
+        gossip_version 0): the net must still commit, with every vote to
+        and from the legacy node on the single-vote wire path."""
+
+        def legacy_node2(i, cfg):
+            if i == 2:
+                cfg.consensus.gossip_vote_batch = False
+
+        nodes, _ = await _make_net(tmp_path, 3, name="mix", mutate_cfg=legacy_node2)
+        try:
+            assert nodes[0].switch.node_info.gossip_version == GOSSIP_BATCH_VERSION
+            assert nodes[2].switch.node_info.gossip_version == 0
+            await _wait_all_height(nodes, 3)
+            for h in range(1, 4):
+                hashes = {n.block_store.load_block(h).hash() for n in nodes}
+                assert len(hashes) == 1, f"height {h} diverged"
+
+            legacy_prefix = nodes[2].node_key.id[:8]
+            # the legacy node never sends batch frames at all...
+            n2_modes = {
+                e.get("mode")
+                for e in nodes[2].flight_recorder.events()
+                if e["kind"] == "gossip.votes"
+            }
+            assert "batch" not in n2_modes and "single" in n2_modes
+            # ...and the batched nodes fall back to single-vote frames for
+            # it while still batching to each other — the fallback is
+            # exercised, not just code-pathed
+            for n in nodes[:2]:
+                evs = [
+                    e for e in n.flight_recorder.events() if e["kind"] == "gossip.votes"
+                ]
+                to_legacy = {e["mode"] for e in evs if e["peer"] == legacy_prefix}
+                assert "batch" not in to_legacy
+                assert "single" in to_legacy
+                assert any(
+                    e["mode"] == "batch" and e["peer"] != legacy_prefix for e in evs
+                )
+        finally:
+            await _stop_net(nodes)
+
+
+# ---------------------------------------------------------------------------
+# mempool sig_precheck (ingress batching satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestMempoolSigPrecheck:
+    async def test_burst_of_signed_txs_is_one_engine_flush(self):
+        from tendermint_tpu.abci import types as abci
+        from tendermint_tpu.mempool import Mempool, MempoolError, make_signed_tx
+
+        class _App:
+            def __init__(self):
+                self.calls = 0
+
+            async def check_tx(self, req):
+                self.calls += 1
+                return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK)
+
+        cv = _CountingVerifier()
+        svc = AsyncBatchVerifier(cv)
+        await svc.start()
+        try:
+            app = _App()
+            mp = Mempool(app, {"sig_precheck": True})
+            mp.sig_verifier = svc
+            keys = [Ed25519PrivKey.from_secret(b"tx%d" % i) for i in range(32)]
+            txs = [
+                make_signed_tx(k, b"burst-key-%d=val" % i)
+                for i, k in enumerate(keys)
+            ]
+            await asyncio.gather(*(mp.check_tx(tx) for tx in txs))
+            assert mp.size() == 32 and app.calls == 32
+            assert len(cv.calls) == 1 and cv.calls[0] == 32, (
+                f"burst should coalesce into one engine flush, got {cv.calls}"
+            )
+            # a tampered envelope is rejected BEFORE the ABCI round-trip
+            bad = bytearray(make_signed_tx(keys[0], b"tampered=1"))
+            bad[-1] ^= 0xFF
+            with pytest.raises(MempoolError, match="signature"):
+                await mp.check_tx(bytes(bad))
+            assert app.calls == 32
+            # non-envelope txs pass through untouched by the filter
+            res = await mp.check_tx(b"plain-key=plain-val")
+            assert res.code == abci.CODE_TYPE_OK
+        finally:
+            await svc.stop()
+
+    async def test_signed_tx_roundtrip(self):
+        from tendermint_tpu.mempool import make_signed_tx, parse_signed_tx
+
+        k = Ed25519PrivKey.from_secret(b"roundtrip")
+        tx = make_signed_tx(k, b"hello=world")
+        pubkey, sign_bytes, sig, payload = parse_signed_tx(tx)
+        assert pubkey == k.pub_key().bytes()
+        assert payload == b"hello=world"
+        assert k.pub_key().verify(sign_bytes, sig)
+        assert parse_signed_tx(b"not an envelope") is None

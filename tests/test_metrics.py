@@ -200,6 +200,12 @@ class TestLiveScrape:
             assert f"tendermint_mempool_failed_txs{{{key}}}" in metrics
             assert f"tendermint_mempool_recheck_times{{{key}}}" in metrics
 
+            # event-driven gossip series: wakeups fired, vote batches and
+            # part bursts were sent (both peers advertise the batched wire)
+            assert metrics[f"tendermint_consensus_gossip_wakeups{{{key}}}"] > 0
+            assert metrics[f"tendermint_consensus_vote_batch_size_count{{{key}}}"] > 0
+            assert metrics[f"tendermint_consensus_parts_per_burst_count{{{key}}}"] > 0
+
             # verify subsystem: the vote-ingress batcher flushed real
             # batches, so the histograms observed and the quantum gauge is live
             assert metrics[f"tendermint_verify_batch_size_count{{{key}}}"] > 0
