@@ -356,6 +356,29 @@ def bench_e2e_4val_procs(duration: float = 12.0):
         return json.loads(run.stdout.strip().splitlines()[-1])
 
 
+def bench_statesync_bootstrap():
+    """Statesync bootstrap time, measured from REAL recorder spans: an
+    empty 4th node joins a live 3-validator localnet via snapshot restore
+    (networks/local/statesync_smoke.py) and reports the
+    offer→chunk→restore→handover wall milliseconds from its own flight
+    recorder — the `statesync_bootstrap_ms` BASELINE entry.  The rig
+    FAILS (raises) if the joiner fell back to replay-from-genesis."""
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as tmp:
+        run = subprocess.run(
+            [sys.executable, os.path.join(repo, "networks", "local", "statesync_smoke.py"),
+             "--build-dir", os.path.join(tmp, "build"), "--base-port", "29756", "--json"],
+            capture_output=True, text=True, timeout=300, cwd=repo,
+        )
+        if run.returncode != 0:
+            raise RuntimeError(f"statesync smoke failed:\n{run.stdout}\n{run.stderr}")
+        return json.loads(run.stdout.strip().splitlines()[-1])
+
+
 async def bench_vote_hop_flush():
     """Latency a SINGLE sparse vote pays in the AsyncBatchVerifier before
     its flush fires (the per-hop quantum the adaptive window shrinks) — at
@@ -593,6 +616,10 @@ def main() -> None:
         procs = bench_e2e_4val_procs()
     except Exception as e:  # the rig must not sink the whole bench report
         procs = {"commits_per_sec": -1.0, "error": str(e)[:300]}
+    try:
+        statesync = bench_statesync_bootstrap()
+    except Exception as e:
+        statesync = {"statesync_bootstrap_ms": -1.0, "error": str(e)[:300]}
     extras = {
         "commit_verify_100val_ms": bench_100val_commit(),
         "e2e_commits_per_sec_solo": asyncio.run(bench_e2e_commits()),
@@ -623,6 +650,8 @@ def main() -> None:
         "table_build_ms": round(primary["table_build_ms"], 1),
         "e2e_commits_per_sec_4val_procs": round(procs.get("commits_per_sec", -1.0), 2),
         "e2e_4val_procs_startup_s": procs.get("startup_s"),
+        "statesync_bootstrap_ms": statesync.get("statesync_bootstrap_ms", -1.0),
+        "statesync_bootstrap_wall_s": statesync.get("bootstrap_wall_s"),
         "vote_hop_flush_ms": round(hop_ms, 3),
         "e2e_4val_recorder": procs.get("recorder"),
         "e2e_4val_breakdown": _e2e_breakdown(procs, hop_ms),

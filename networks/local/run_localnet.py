@@ -98,6 +98,25 @@ def poll_heights(rpc_ports) -> list:
     return heights
 
 
+def poll_ready(rpc_ports) -> list:
+    """Per-node readiness: height >= 1 AND the node reports sync phase
+    `caught_up` (`/status` sync_info.sync_phase — a node mid-statesync or
+    mid-fastsync serves RPC long before it can keep up with the net, so
+    height alone is a premature gate).  Missing key falls back to the old
+    height-only check."""
+    ready = []
+    for port in rpc_ports:
+        try:
+            si = rpc(port, "status")["result"]["sync_info"]
+            ok = int(si["latest_block_height"]) >= 1
+            if "sync_phase" in si:
+                ok = ok and si["sync_phase"] == "caught_up"
+            ready.append(ok)
+        except Exception:
+            ready.append(False)
+    return ready
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("build_dir")
@@ -145,9 +164,10 @@ def main() -> int:
         t_start = time.time()
         ready_deadline = t_start + args.startup_timeout
         while time.time() < ready_deadline:
-            heights = poll_heights(rpc_ports)
-            if min(heights) >= 1:
-                break
+            if all(poll_ready(rpc_ports)):
+                heights = poll_heights(rpc_ports)
+                if min(heights) >= 1:
+                    break
             if any(p.poll() is not None for p in procs):
                 print("a node process exited during startup", file=sys.stderr)
                 return 1

@@ -57,6 +57,22 @@ class Client(Service):
     async def commit(self) -> t.ResponseCommit:
         raise NotImplementedError
 
+    async def list_snapshots(self, req: t.RequestListSnapshots) -> t.ResponseListSnapshots:
+        raise NotImplementedError
+
+    async def offer_snapshot(self, req: t.RequestOfferSnapshot) -> t.ResponseOfferSnapshot:
+        raise NotImplementedError
+
+    async def load_snapshot_chunk(
+        self, req: t.RequestLoadSnapshotChunk
+    ) -> t.ResponseLoadSnapshotChunk:
+        raise NotImplementedError
+
+    async def apply_snapshot_chunk(
+        self, req: t.RequestApplySnapshotChunk
+    ) -> t.ResponseApplySnapshotChunk:
+        raise NotImplementedError
+
 
 class LocalClient(Client):
     """Wraps an in-proc Application (abci/client/local_client.go).  One
@@ -105,6 +121,22 @@ class LocalClient(Client):
 
     async def commit(self) -> t.ResponseCommit:
         return await self._call(self.app.commit, t.RequestCommit())
+
+    async def list_snapshots(self, req: t.RequestListSnapshots) -> t.ResponseListSnapshots:
+        return await self._call(self.app.list_snapshots, req)
+
+    async def offer_snapshot(self, req: t.RequestOfferSnapshot) -> t.ResponseOfferSnapshot:
+        return await self._call(self.app.offer_snapshot, req)
+
+    async def load_snapshot_chunk(
+        self, req: t.RequestLoadSnapshotChunk
+    ) -> t.ResponseLoadSnapshotChunk:
+        return await self._call(self.app.load_snapshot_chunk, req)
+
+    async def apply_snapshot_chunk(
+        self, req: t.RequestApplySnapshotChunk
+    ) -> t.ResponseApplySnapshotChunk:
+        return await self._call(self.app.apply_snapshot_chunk, req)
 
 
 # ---------------------------------------------------------------------------
@@ -216,3 +248,19 @@ class SocketClient(Client):
 
     async def commit(self) -> t.ResponseCommit:
         return await self._request("commit", t.RequestCommit())
+
+    async def list_snapshots(self, req: t.RequestListSnapshots) -> t.ResponseListSnapshots:
+        return await self._request("list_snapshots", req)
+
+    async def offer_snapshot(self, req: t.RequestOfferSnapshot) -> t.ResponseOfferSnapshot:
+        return await self._request("offer_snapshot", req)
+
+    async def load_snapshot_chunk(
+        self, req: t.RequestLoadSnapshotChunk
+    ) -> t.ResponseLoadSnapshotChunk:
+        return await self._request("load_snapshot_chunk", req)
+
+    async def apply_snapshot_chunk(
+        self, req: t.RequestApplySnapshotChunk
+    ) -> t.ResponseApplySnapshotChunk:
+        return await self._request("apply_snapshot_chunk", req)

@@ -13,26 +13,61 @@ import hashlib
 import struct
 from typing import Dict, List, Optional
 
+from ..encoding import codec
 from ..libs.kvstore import KVStore, MemDB
 from . import types as t
 
 VALIDATOR_TX_PREFIX = b"val:"
+
+# snapshot bookkeeping keys — excluded from snapshot payloads
+_SNAP_META_PREFIX = b"__snapmeta__:"
+_SNAP_CHUNK_PREFIX = b"__snapchunk__:"
+SNAPSHOT_FORMAT = 1
+
+
+def _k_snap_meta(height: int) -> bytes:
+    return _SNAP_META_PREFIX + b"%016d" % height
+
+
+def _k_snap_chunk(height: int, index: int) -> bytes:
+    return _SNAP_CHUNK_PREFIX + b"%016d:%08d" % (height, index)
 
 
 class KVStoreApplication(t.Application):
     """Merkle-less KV app.  Tx "key=value" sets key; bare "v" sets v=v.
     "val:<b64 pubkey>!<power>" updates the validator set (the mechanism the
     validator-change tests drive).  app_hash commits to (size, update
-    count) deterministically."""
+    count) deterministically.
 
-    def __init__(self, db: Optional[KVStore] = None, retain_blocks: int = 0):
+    With `snapshot_interval` > 0 the app takes a state snapshot at every
+    multiple of that height during `commit` (abci/example/kvstore
+    PersistentKVStoreApplication snapshot flavor): the full key space is
+    serialized, split into `snapshot_chunk_bytes` chunks addressed by
+    SHA-256, and served via the four ABCI snapshot methods.  Snapshot
+    metadata carries the chunk-hash list so both the statesync chunk
+    scheduler and the restoring app verify every chunk by hash before it
+    touches state."""
+
+    def __init__(
+        self,
+        db: Optional[KVStore] = None,
+        retain_blocks: int = 0,
+        snapshot_interval: int = 0,
+        snapshot_keep_recent: int = 2,
+        snapshot_chunk_bytes: int = 65536,
+    ):
         self.db = db or MemDB()
         self.retain_blocks = retain_blocks
+        self.snapshot_interval = snapshot_interval
+        self.snapshot_keep_recent = max(1, snapshot_keep_recent)
+        self.snapshot_chunk_bytes = max(1, snapshot_chunk_bytes)
         self.height = 0
         self.app_hash = b""
         self.tx_count = 0
         self.validators: Dict[bytes, int] = {}  # pubkey -> power
         self._pending_updates: List[t.ValidatorUpdate] = []
+        # in-flight restore: {"snapshot", "app_hash", "hashes", "buf", "next"}
+        self._restore: Optional[dict] = None
         self._load_state()
 
     # -- state persistence -------------------------------------------------
@@ -130,10 +165,146 @@ class KVStoreApplication(t.Application):
             struct.pack("<QQ", self.tx_count, self.height)
         ).digest()
         self._save_state()
+        if self.snapshot_interval > 0 and self.height % self.snapshot_interval == 0:
+            self._take_snapshot()
         retain = 0
         if self.retain_blocks > 0 and self.height >= self.retain_blocks:
             retain = self.height - self.retain_blocks + 1
         return t.ResponseCommit(data=self.app_hash, retain_height=retain)
+
+    # -- state-sync snapshots ----------------------------------------------
+
+    def _snapshot_payload(self) -> bytes:
+        """Deterministic serialization of the whole key space (sorted),
+        excluding snapshot bookkeeping keys."""
+        entries = sorted(
+            (k, v)
+            for k, v in self.db.iterate_prefix(b"")
+            if not k.startswith(_SNAP_META_PREFIX) and not k.startswith(_SNAP_CHUNK_PREFIX)
+        )
+        return codec.dumps({"entries": entries})
+
+    def _take_snapshot(self) -> None:
+        payload = self._snapshot_payload()
+        size = self.snapshot_chunk_bytes
+        chunks = [payload[i : i + size] for i in range(0, len(payload), size)] or [b""]
+        hashes = [hashlib.sha256(c).digest() for c in chunks]
+        snap = t.Snapshot(
+            height=self.height,
+            format=SNAPSHOT_FORMAT,
+            chunks=len(chunks),
+            hash=hashlib.sha256(b"".join(hashes)).digest(),
+            metadata=codec.dumps({"chunk_hashes": hashes}),
+        )
+        sets = [(_k_snap_meta(self.height), codec.dumps(vars(snap)))]
+        sets += [(_k_snap_chunk(self.height, i), c) for i, c in enumerate(chunks)]
+        self.db.write_batch(sets)
+        # prune beyond keep_recent
+        heights = sorted(self._snapshot_heights())
+        for h in heights[: -self.snapshot_keep_recent]:
+            meta = self._load_snapshot_meta(h)
+            self.db.delete(_k_snap_meta(h))
+            if meta is not None:
+                for i in range(meta.chunks):
+                    self.db.delete(_k_snap_chunk(h, i))
+
+    def _snapshot_heights(self) -> List[int]:
+        return [
+            int(k[len(_SNAP_META_PREFIX):]) for k, _ in self.db.iterate_prefix(_SNAP_META_PREFIX)
+        ]
+
+    def _load_snapshot_meta(self, height: int) -> Optional[t.Snapshot]:
+        raw = self.db.get(_k_snap_meta(height))
+        return t.Snapshot(**codec.loads(raw)) if raw else None
+
+    def list_snapshots(self, req: t.RequestListSnapshots) -> t.ResponseListSnapshots:
+        snaps = [self._load_snapshot_meta(h) for h in sorted(self._snapshot_heights())]
+        return t.ResponseListSnapshots(snapshots=[s for s in snaps if s is not None])
+
+    def load_snapshot_chunk(self, req: t.RequestLoadSnapshotChunk) -> t.ResponseLoadSnapshotChunk:
+        if req.format != SNAPSHOT_FORMAT:
+            return t.ResponseLoadSnapshotChunk()
+        chunk = self.db.get(_k_snap_chunk(req.height, req.chunk))
+        return t.ResponseLoadSnapshotChunk(chunk=chunk or b"")
+
+    def offer_snapshot(self, req: t.RequestOfferSnapshot) -> t.ResponseOfferSnapshot:
+        snap = req.snapshot
+        if snap is None or snap.chunks < 1 or snap.height < 1:
+            return t.ResponseOfferSnapshot(result=t.OfferSnapshotResult.REJECT)
+        if snap.format != SNAPSHOT_FORMAT:
+            return t.ResponseOfferSnapshot(result=t.OfferSnapshotResult.REJECT_FORMAT)
+        try:
+            hashes = codec.loads(snap.metadata)["chunk_hashes"]
+        except Exception:
+            return t.ResponseOfferSnapshot(result=t.OfferSnapshotResult.REJECT)
+        if (
+            not isinstance(hashes, list)
+            or len(hashes) != snap.chunks
+            or any(not isinstance(h, bytes) or len(h) != 32 for h in hashes)
+            or hashlib.sha256(b"".join(hashes)).digest() != snap.hash
+        ):
+            return t.ResponseOfferSnapshot(result=t.OfferSnapshotResult.REJECT)
+        self._restore = {
+            "snapshot": snap,
+            "app_hash": req.app_hash,
+            "hashes": hashes,
+            "buf": [],
+            "next": 0,
+        }
+        return t.ResponseOfferSnapshot(result=t.OfferSnapshotResult.ACCEPT)
+
+    def apply_snapshot_chunk(self, req: t.RequestApplySnapshotChunk) -> t.ResponseApplySnapshotChunk:
+        R = t.ApplySnapshotChunkResult
+        if self._restore is None:
+            return t.ResponseApplySnapshotChunk(result=R.ABORT)
+        ctx = self._restore
+        if req.index != ctx["next"]:
+            # chunks apply strictly in order; out-of-order is a scheduler
+            # bug or a replay — ask for the expected one again
+            return t.ResponseApplySnapshotChunk(
+                result=R.RETRY, refetch_chunks=[ctx["next"]]
+            )
+        if hashlib.sha256(req.chunk).digest() != ctx["hashes"][req.index]:
+            # defense in depth: the syncer verifies hashes too, but a bad
+            # chunk must never enter state even if it slips through
+            return t.ResponseApplySnapshotChunk(
+                result=R.RETRY,
+                refetch_chunks=[req.index],
+                reject_senders=[req.sender] if req.sender else [],
+            )
+        ctx["buf"].append(req.chunk)
+        ctx["next"] += 1
+        if ctx["next"] < ctx["snapshot"].chunks:
+            return t.ResponseApplySnapshotChunk(result=R.ACCEPT)
+        # final chunk: decode + replace state wholesale
+        try:
+            entries = codec.loads(b"".join(ctx["buf"]))["entries"]
+        except Exception:
+            self._restore = None
+            return t.ResponseApplySnapshotChunk(result=R.REJECT_SNAPSHOT)
+        for k, _ in list(self.db.iterate_prefix(b"kv:")):
+            self.db.delete(k)
+        for k, _ in list(self.db.iterate_prefix(b"__val__")):
+            self.db.delete(k)
+        for k, v in entries:
+            self.db.set(k, v)
+        self.validators = {}
+        self._load_state()
+        self._restore = None
+        if self.height != ctx["snapshot"].height or (
+            ctx["app_hash"] and self.app_hash != ctx["app_hash"]
+        ):
+            # restored state does not match the trusted header — poisoned
+            # snapshot; wipe what we wrote and reject
+            self.height, self.tx_count, self.app_hash = 0, 0, b""
+            for k, _ in list(self.db.iterate_prefix(b"kv:")):
+                self.db.delete(k)
+            for k, _ in list(self.db.iterate_prefix(b"__val__")):
+                self.db.delete(k)
+            self.db.delete(b"__state__")
+            self.validators = {}
+            return t.ResponseApplySnapshotChunk(result=R.REJECT_SNAPSHOT)
+        return t.ResponseApplySnapshotChunk(result=R.ACCEPT)
 
     def query(self, req: t.RequestQuery) -> t.ResponseQuery:
         if req.path == "/val":

@@ -34,6 +34,10 @@ _METHODS = (
     "deliver_tx",
     "end_block",
     "commit",
+    "list_snapshots",
+    "offer_snapshot",
+    "load_snapshot_chunk",
+    "apply_snapshot_chunk",
 )
 
 
@@ -174,3 +178,19 @@ class GRPCClient(Service):
 
     async def commit(self) -> t.ResponseCommit:
         return await self._call("commit", t.RequestCommit())
+
+    async def list_snapshots(self, req: t.RequestListSnapshots) -> t.ResponseListSnapshots:
+        return await self._call("list_snapshots", req)
+
+    async def offer_snapshot(self, req: t.RequestOfferSnapshot) -> t.ResponseOfferSnapshot:
+        return await self._call("offer_snapshot", req)
+
+    async def load_snapshot_chunk(
+        self, req: t.RequestLoadSnapshotChunk
+    ) -> t.ResponseLoadSnapshotChunk:
+        return await self._call("load_snapshot_chunk", req)
+
+    async def apply_snapshot_chunk(
+        self, req: t.RequestApplySnapshotChunk
+    ) -> t.ResponseApplySnapshotChunk:
+        return await self._call("apply_snapshot_chunk", req)

@@ -26,6 +26,10 @@ _METHODS = {
     "deliver_tx": "deliver_tx",
     "end_block": "end_block",
     "commit": "commit",
+    "list_snapshots": "list_snapshots",
+    "offer_snapshot": "offer_snapshot",
+    "load_snapshot_chunk": "load_snapshot_chunk",
+    "apply_snapshot_chunk": "apply_snapshot_chunk",
 }
 
 

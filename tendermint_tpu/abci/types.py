@@ -41,6 +41,42 @@ class LastCommitInfo:
     votes: List[dict] = field(default_factory=list)  # {"address", "power", "signed_last_block"}
 
 
+@dataclass
+class Snapshot:
+    """An application state snapshot offered for state sync
+    (abci/types/types.proto Snapshot).  `metadata` is opaque to the node
+    core; the example kvstore app stores its chunk-hash list there so both
+    the syncer and the restoring app can verify chunks by hash."""
+
+    height: int = 0
+    format: int = 0
+    chunks: int = 0
+    hash: bytes = b""
+    metadata: bytes = b""
+
+
+class OfferSnapshotResult:
+    """ResponseOfferSnapshot.Result (types.proto)."""
+
+    UNKNOWN = 0
+    ACCEPT = 1  # apply this snapshot
+    ABORT = 2  # abort all snapshot restoration
+    REJECT = 3  # reject this snapshot, try others
+    REJECT_FORMAT = 4  # reject this format, try other formats
+    REJECT_SENDER = 5  # reject all snapshots from these senders
+
+
+class ApplySnapshotChunkResult:
+    """ResponseApplySnapshotChunk.Result (types.proto)."""
+
+    UNKNOWN = 0
+    ACCEPT = 1  # chunk applied
+    ABORT = 2  # abort all snapshot restoration
+    RETRY = 3  # refetch + reapply this chunk
+    RETRY_SNAPSHOT = 4  # restart this snapshot from scratch
+    REJECT_SNAPSHOT = 5  # reject this snapshot, try others
+
+
 # -- requests ---------------------------------------------------------------
 
 
@@ -111,6 +147,31 @@ class RequestEndBlock:
 @dataclass
 class RequestCommit:
     pass
+
+
+@dataclass
+class RequestListSnapshots:
+    pass
+
+
+@dataclass
+class RequestOfferSnapshot:
+    snapshot: Optional[Snapshot] = None
+    app_hash: bytes = b""  # light-client-verified app hash at snapshot height
+
+
+@dataclass
+class RequestLoadSnapshotChunk:
+    height: int = 0
+    format: int = 0
+    chunk: int = 0  # chunk index
+
+
+@dataclass
+class RequestApplySnapshotChunk:
+    index: int = 0
+    chunk: bytes = b""
+    sender: str = ""  # p2p id of the peer that served the chunk
 
 
 # -- responses --------------------------------------------------------------
@@ -220,6 +281,28 @@ class ResponseCommit:
     retain_height: int = 0
 
 
+@dataclass
+class ResponseListSnapshots:
+    snapshots: List[Snapshot] = field(default_factory=list)
+
+
+@dataclass
+class ResponseOfferSnapshot:
+    result: int = OfferSnapshotResult.UNKNOWN
+
+
+@dataclass
+class ResponseLoadSnapshotChunk:
+    chunk: bytes = b""
+
+
+@dataclass
+class ResponseApplySnapshotChunk:
+    result: int = ApplySnapshotChunkResult.UNKNOWN
+    refetch_chunks: List[int] = field(default_factory=list)  # refetch + reapply
+    reject_senders: List[str] = field(default_factory=list)  # ban these peers
+
+
 # wire tags for the socket protocol; both directions share the registry
 _MSG_TYPES = {
     "echo": (RequestEcho, ResponseEcho),
@@ -233,6 +316,10 @@ _MSG_TYPES = {
     "deliver_tx": (RequestDeliverTx, ResponseDeliverTx),
     "end_block": (RequestEndBlock, ResponseEndBlock),
     "commit": (RequestCommit, ResponseCommit),
+    "list_snapshots": (RequestListSnapshots, ResponseListSnapshots),
+    "offer_snapshot": (RequestOfferSnapshot, ResponseOfferSnapshot),
+    "load_snapshot_chunk": (RequestLoadSnapshotChunk, ResponseLoadSnapshotChunk),
+    "apply_snapshot_chunk": (RequestApplySnapshotChunk, ResponseApplySnapshotChunk),
     "exception": (None, ResponseException),
 }
 
@@ -241,6 +328,8 @@ _NESTED = {
     "validator_updates": ValidatorUpdate,
     "events": Event,
     "last_commit_info": LastCommitInfo,
+    "snapshots": Snapshot,
+    "snapshot": Snapshot,
 }
 
 
@@ -259,8 +348,8 @@ def decode_msg(d: dict, direction: int):
     for key, sub in _NESTED.items():
         if key in d and isinstance(d[key], list):
             d[key] = [sub(**v) if isinstance(v, dict) else v for v in d[key]]
-        elif key in d and isinstance(d[key], dict) and sub is LastCommitInfo:
-            d[key] = LastCommitInfo(**d[key])
+        elif key in d and isinstance(d[key], dict):
+            d[key] = sub(**d[key])
     return kind, cls(**d)
 
 
@@ -302,6 +391,19 @@ class Application(ABC):
 
     def commit(self, req: RequestCommit) -> ResponseCommit:
         return ResponseCommit()
+
+    # -- state-sync snapshot protocol (abci/types/application.go) ----------
+    def list_snapshots(self, req: RequestListSnapshots) -> ResponseListSnapshots:
+        return ResponseListSnapshots()
+
+    def offer_snapshot(self, req: RequestOfferSnapshot) -> ResponseOfferSnapshot:
+        return ResponseOfferSnapshot()
+
+    def load_snapshot_chunk(self, req: RequestLoadSnapshotChunk) -> ResponseLoadSnapshotChunk:
+        return ResponseLoadSnapshotChunk()
+
+    def apply_snapshot_chunk(self, req: RequestApplySnapshotChunk) -> ResponseApplySnapshotChunk:
+        return ResponseApplySnapshotChunk()
 
 
 class BaseApplication(Application):

@@ -75,10 +75,15 @@ def cmd_run(args) -> int:
     # mostly acyclic (bytes/dataclasses), so gen0 pressure is cheap to defer.
     gc.set_threshold(50_000, 50, 25)
 
+    from .libs.log import parse_log_level, setup as setup_logging
+
     cfg = _load_cfg(args.home)
     if args.proxy_app:
         cfg.base.proxy_app = args.proxy_app
     cfg.validate_basic()
+    # honor [base] log_level — without a handler the node's structured
+    # logs (statesync/fastsync progress, errors) vanish entirely
+    setup_logging(module_levels=parse_log_level(cfg.base.log_level))
     node = default_new_node(cfg)
 
     async def _main() -> None:

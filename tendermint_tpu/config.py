@@ -107,6 +107,34 @@ class FastSyncConfig:
 
 
 @dataclass
+class StateSyncConfig:
+    """Snapshot bootstrap (reference config.StateSyncConfig).  With
+    `enable`, a node whose stores are EMPTY restores a peer-served app
+    snapshot verified against a lite2 trust root instead of replaying
+    from genesis, then fastsyncs the tail.  `rpc_servers` (comma-
+    separated) back the light client; `trust_height`/`trust_hash` (hex)
+    are the subjective-security root, valid for `trust_period` seconds.
+
+    `snapshot_interval`/`snapshot_chunk_bytes` are the APP side: the
+    builtin kvstore takes a snapshot every N heights at commit."""
+
+    enable: bool = False
+    rpc_servers: str = ""
+    trust_height: int = 0
+    trust_hash: str = ""  # hex
+    trust_period: float = 168 * 3600.0  # seconds (reference: 168h0m0s)
+    discovery_time: float = 3.0  # seconds collecting peer snapshot offers
+    chunk_fetch_timeout: float = 10.0  # per-chunk request timeout (seconds)
+    chunk_fetch_retries: int = 4  # bounded retries per chunk
+    snapshot_interval: int = 0  # app side: snapshot every N heights (0 = off)
+    snapshot_chunk_bytes: int = 65536  # app side: chunk size
+    # app side: snapshots retained for serving.  Lifetime of a snapshot is
+    # keep_recent × interval blocks — on fast chains keep enough that a
+    # joiner's discovery + trust-root + chunk fetch fits inside it.
+    snapshot_keep_recent: int = 2
+
+
+@dataclass
 class ConsensusConfig:
     wal_file: str = "data/cs.wal/wal"
     # reference defaults (config/config.go:774-790)
@@ -202,6 +230,7 @@ class Config:
     p2p: P2PConfig = field(default_factory=P2PConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     fast_sync: FastSyncConfig = field(default_factory=FastSyncConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
@@ -263,6 +292,25 @@ class Config:
             raise ValueError("consensus.gossip_part_burst must be >= 1")
         if self.consensus.gossip_vote_batch_bytes < 1024:
             raise ValueError("consensus.gossip_vote_batch_bytes must be >= 1024")
+        ss = self.statesync
+        if ss.enable:
+            if not ss.rpc_servers.strip():
+                raise ValueError("statesync.enable requires statesync.rpc_servers")
+            if ss.trust_height < 1:
+                raise ValueError("statesync.enable requires statesync.trust_height >= 1")
+            try:
+                if len(bytes.fromhex(ss.trust_hash)) != 32:
+                    raise ValueError
+            except ValueError:
+                raise ValueError("statesync.trust_hash must be 32 hex-encoded bytes")
+        if ss.snapshot_interval < 0:
+            raise ValueError("statesync.snapshot_interval can't be negative")
+        if ss.snapshot_chunk_bytes < 1:
+            raise ValueError("statesync.snapshot_chunk_bytes must be >= 1")
+        if ss.snapshot_keep_recent < 1:
+            raise ValueError("statesync.snapshot_keep_recent must be >= 1")
+        if ss.chunk_fetch_retries < 0:
+            raise ValueError("statesync.chunk_fetch_retries can't be negative")
 
 
 def default_config(home: str = "~/.tendermint_tpu") -> Config:
@@ -309,6 +357,7 @@ def save_config(cfg: Config, path: str) -> None:
         "p2p": cfg.p2p,
         "mempool": cfg.mempool,
         "fastsync": cfg.fast_sync,
+        "statesync": cfg.statesync,
         "consensus": cfg.consensus,
         "tpu": cfg.tpu,
         "tx_index": cfg.tx_index,
@@ -355,6 +404,7 @@ def load_config(path: str, home: Optional[str] = None) -> Config:
     apply(cfg.p2p, data.get("p2p", {}))
     apply(cfg.mempool, data.get("mempool", {}))
     apply(cfg.fast_sync, data.get("fastsync", {}))
+    apply(cfg.statesync, data.get("statesync", {}))
     apply(cfg.consensus, data.get("consensus", {}))
     apply(cfg.tpu, data.get("tpu", {}))
     apply(cfg.tx_index, data.get("tx_index", {}))

@@ -622,14 +622,10 @@ class ConsensusReactor(Reactor):
         the same tick the (constantly-fired) event completes would be
         swallowed (bpo-42130) and the routine would outlive its peer —
         same mechanism as the SignerClient/Service.stop fix."""
-        waiter = asyncio.ensure_future(event.wait())
-        try:
-            done, _ = await asyncio.wait({waiter}, timeout=self._fallback_cap(cap))
-        except asyncio.CancelledError:
-            waiter.cancel()
-            raise
-        if not done:
-            waiter.cancel()
+        from ..libs.service import wait_event
+
+        fired = await wait_event(event, self._fallback_cap(cap))
+        if not fired:
             return
         self.cs.metrics.gossip_wakeups.inc()
         self.cs.recorder.record("gossip.wakeup", peer=peer.id[:8])

@@ -14,6 +14,7 @@ from typing import Optional
 
 from ..encoding import codec
 from ..libs.log import get_logger
+from ..libs.service import wait_event
 from ..p2p import ChannelDescriptor, Reactor
 from ..p2p import behaviour
 from ..types import Block, BlockID
@@ -23,7 +24,12 @@ from .scheduler import Scheduler
 
 BLOCKCHAIN_CHANNEL = 0x40
 STATUS_BROADCAST_INTERVAL = 2.0
+# Event-driven pool routine (PR 3 gossip design): block arrivals, status
+# changes and peer churn set a wakeup event; the old 10 ms TRY_SYNC poll
+# survives only as a repair fallback at 10x + a 250 ms floor (it reaps
+# request timeouts and catches any missed edge).
 TRY_SYNC_INTERVAL = 0.01
+POOL_FALLBACK_TICK = max(TRY_SYNC_INTERVAL * 10, 0.25)
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0
 
 
@@ -35,12 +41,17 @@ class BlockchainReactor(Reactor):
         block_store,
         fast_sync: bool,
         consensus_reactor=None,  # for the handover
+        wait_statesync: bool = False,  # dormant until statesync hands over
     ):
         super().__init__("blockchain-reactor")
         self.state = state
         self.block_exec = block_exec
         self.block_store = block_store
         self.fast_sync = fast_sync
+        # statesync runs first: the pool routine must NOT start requesting
+        # blocks from genesis while the snapshot restore is in flight —
+        # switch_to_fastsync() activates it with the restored state
+        self.wait_statesync = wait_statesync
         self.consensus_reactor = consensus_reactor
         self.log = get_logger("fastsync")
         # behaviour reporter (behaviour/reporter.go): peer conduct flows
@@ -51,6 +62,8 @@ class BlockchainReactor(Reactor):
         self.processor = Processor(start_height)
         self.blocks_synced = 0
         self._started_at = 0.0
+        self._wake: Optional[asyncio.Event] = None
+        self.statesync_metrics = None  # node wires StateSyncMetrics (phase gauge)
 
     def get_channels(self):
         return [
@@ -64,9 +77,33 @@ class BlockchainReactor(Reactor):
 
     async def on_start(self) -> None:
         self._started_at = time.monotonic()
-        if self.fast_sync:
+        self._wake = asyncio.Event()
+        if self.fast_sync and not self.wait_statesync:
             self.spawn(self._pool_routine(), "pool")
         self.spawn(self._status_broadcast_routine(), "status-bcast")
+
+    def _wake_pool(self) -> None:
+        if self._wake is not None:
+            self._wake.set()
+
+    async def switch_to_fastsync(self, state) -> None:
+        """Statesync → fastsync handover: adopt the snapshot-restored
+        state, rebuild the scheduler/processor at the new start height,
+        and activate the pool routine for the tail."""
+        self.state = state
+        self.wait_statesync = False
+        self.fast_sync = True
+        start_height = max(self.block_store.height() + 1, state.last_block_height + 1)
+        self.scheduler = Scheduler(start_height)
+        self.processor = Processor(start_height)
+        self._started_at = time.monotonic()
+        if self.switch is not None:
+            for peer in self.switch.peer_list():
+                self.scheduler.add_peer(peer.id)
+                peer.try_send(BLOCKCHAIN_CHANNEL, _enc("status_request", {}))
+        self.log.info("switching to fast sync", height=state.last_block_height)
+        self.spawn(self._pool_routine(), "pool")
+        self._wake_pool()
 
     # -- peer lifecycle ----------------------------------------------------
     async def add_peer(self, peer) -> None:
@@ -75,10 +112,12 @@ class BlockchainReactor(Reactor):
         }))
         if self.fast_sync:
             self.scheduler.add_peer(peer.id)
+            self._wake_pool()
 
     async def remove_peer(self, peer, reason=None) -> None:
         freed = self.scheduler.remove_peer(peer.id)
         self.processor.drop_heights(freed)
+        self._wake_pool()
 
     async def _report(self, b) -> None:
         if self.reporter is None:
@@ -99,6 +138,7 @@ class BlockchainReactor(Reactor):
         elif kind == "status_response":
             if self.fast_sync:
                 self.scheduler.set_peer_range(peer.id, msg["base"], msg["height"])
+                self._wake_pool()
         elif kind == "block_request":
             await self._serve_block(peer, msg["height"])
         elif kind == "block_response":
@@ -111,12 +151,14 @@ class BlockchainReactor(Reactor):
                 return
             if self.scheduler.block_received(peer.id, block.height):
                 self.processor.add_block(block.height, block, peer.id)
+                self._wake_pool()
             else:
                 await self._report(
                     behaviour.message_out_of_order(peer.id, "unsolicited block")
                 )
         elif kind == "no_block_response":
             self.scheduler.no_block(peer.id, msg["height"])
+            self._wake_pool()
 
     async def _serve_block(self, peer, height: int) -> None:
         block = self.block_store.load_block(height)
@@ -132,7 +174,10 @@ class BlockchainReactor(Reactor):
             await asyncio.sleep(STATUS_BROADCAST_INTERVAL)
 
     async def _pool_routine(self) -> None:
-        """v0 poolRoutine:216 — request scheduling + trySync + handover."""
+        """v0 poolRoutine:216 — request scheduling + trySync + handover,
+        event-driven: block arrivals / status changes / peer churn set
+        `_wake`; the sleep is only the repair fallback (timeout reaping),
+        so an idle syncer costs ~4 scheduler slots/sec instead of 100."""
         last_switch_check = 0.0
         while True:
             now = time.monotonic()
@@ -157,7 +202,8 @@ class BlockchainReactor(Reactor):
                 if self.scheduler.only_tip_outstanding():
                     await self._switch_to_consensus()
                     return
-            await asyncio.sleep(TRY_SYNC_INTERVAL)
+            await wait_event(self._wake, POOL_FALLBACK_TICK)
+            self._wake.clear()
 
     async def _try_sync(self) -> None:
         """Verify + apply contiguous pairs (v0 reactor.go:244 trySync)."""
@@ -202,6 +248,8 @@ class BlockchainReactor(Reactor):
         self.fast_sync = False
         if self.consensus_reactor is not None and self.consensus_reactor.cs is not None:
             self.consensus_reactor.cs.metrics.fast_syncing.set(0)
+        if self.statesync_metrics is not None:
+            self.statesync_metrics.sync_phase.set(self.statesync_metrics.PHASE_CAUGHT_UP)
         if self.consensus_reactor is not None:
             await self.consensus_reactor.switch_to_consensus(self.state, self.blocks_synced)
             # late gossip routines for peers added while syncing

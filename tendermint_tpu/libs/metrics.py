@@ -270,6 +270,61 @@ class VerifyMetrics:
         )
 
 
+class StateSyncMetrics:
+    """Snapshot bootstrap (subsystem `statesync`): discovery and chunk
+    transfer counters, restore-duration histogram, and the node's sync
+    phase (2=statesync, 1=fastsync, 0=caught_up) — the `tendermint_
+    statesync_*` series the statesync-smoke rig and dashboards read."""
+
+    PHASE_CAUGHT_UP = 0
+    PHASE_FASTSYNC = 1
+    PHASE_STATESYNC = 2
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        if registry is None:
+            for name in (
+                "snapshots_discovered", "snapshots_offered", "chunks_fetched",
+                "chunks_failed", "chunks_refetched", "restore_duration_seconds",
+                "sync_phase",
+            ):
+                setattr(self, name, _NOP)
+            return
+        from prometheus_client import Counter, Gauge, Histogram
+
+        kw = dict(namespace=NAMESPACE, subsystem="statesync", registry=registry,
+                  labelnames=("chain_id",))
+
+        def c(name, doc):
+            return Counter(name, doc, **kw).labels(chain_id=chain_id)
+
+        self.snapshots_discovered = c(
+            "snapshots_discovered", "Distinct snapshots advertised by peers."
+        )
+        self.snapshots_offered = c(
+            "snapshots_offered", "Snapshots offered to the local app."
+        )
+        self.chunks_fetched = c(
+            "chunks_fetched", "Snapshot chunks fetched and hash-verified."
+        )
+        self.chunks_failed = c(
+            "chunks_failed", "Snapshot chunks that failed hash verification."
+        )
+        self.chunks_refetched = c(
+            "chunks_refetched", "Snapshot chunks refetched (bad hash, timeout or app retry)."
+        )
+        self.restore_duration_seconds = Histogram(
+            "restore_duration_seconds",
+            "Wall time from snapshot offer to verified restore.",
+            buckets=[0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0],
+            **kw,
+        ).labels(chain_id=chain_id)
+        self.sync_phase = Gauge(
+            "sync_phase",
+            "Current sync phase: 2=statesync, 1=fastsync, 0=caught_up.",
+            **kw,
+        ).labels(chain_id=chain_id)
+
+
 class MetricsProvider:
     """node/node.go:128 DefaultMetricsProvider — one registry per node."""
 
@@ -286,6 +341,7 @@ class MetricsProvider:
         self.mempool = MempoolMetrics(self.registry, chain_id)
         self.state = StateMetrics(self.registry, chain_id)
         self.verify = VerifyMetrics(self.registry, chain_id)
+        self.statesync = StateSyncMetrics(self.registry, chain_id)
 
     def exposition(self) -> bytes:
         if self.registry is None:

@@ -140,3 +140,22 @@ class Service:
     async def wait_stopped(self) -> None:
         if self._quit is not None:
             await self._quit.wait()
+
+
+async def wait_event(event: asyncio.Event, timeout: float) -> bool:
+    """Wait for an Event with a timeout; True iff the event fired.
+
+    asyncio.wait, NOT wait_for: on py3.10 a cancellation landing in the
+    same tick the event completes would be swallowed (bpo-42130) and the
+    caller would outlive its cancel.  The waiter task is cancelled on
+    every exit path — including the caller's own cancellation — so no
+    orphaned `Event.wait` task leaks (the conftest leak-guard class).
+    Callers clear the event themselves, preserving their own
+    clear-before-scan disciplines."""
+    waiter = asyncio.ensure_future(event.wait())
+    try:
+        done, _ = await asyncio.wait({waiter}, timeout=timeout)
+        return bool(done)
+    finally:
+        if not waiter.done():
+            waiter.cancel()

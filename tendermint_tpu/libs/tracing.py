@@ -28,6 +28,11 @@ Event kinds currently emitted:
     gossip.votes      mode, n, bytes           vote send: mode batch|single
     gossip.vote_batch_recv  n                  decoded batch entered the verifier
     gossip.part_burst n[, catchup]             block parts sent in one burst
+  statesync (statesync/syncer.py + reactor.py, bootstrap only):
+    statesync.offer   height, format, chunks, result   snapshot offered to the app
+    statesync.chunk   index, total, peer       chunk hash-verified + applied
+    statesync.restore height, ms               app restored + checked vs verified header
+    statesync.handover  height                 restored state handed to fastsync
 
 Events are flat dicts: {"seq", "t_ns", "kind", **fields}.  `t_ns` is
 time.monotonic_ns() — deltas are meaningful, wall-clock is not.
@@ -178,3 +183,29 @@ def block_breakdown(events: List[dict]) -> Optional[dict]:
         "commit_ms": round(med(commit_ms), 3),
         "block_ms": round(med(block_ms), 3),
     }
+
+
+#: The statesync bootstrap chain every snapshot restore must record, in
+#: order — the statesync-smoke acceptance gate.
+STATESYNC_CHAIN = ("statesync.offer", "statesync.chunk", "statesync.restore", "statesync.handover")
+
+
+def statesync_bootstrap_ms(events: List[dict]) -> Optional[float]:
+    """Wall milliseconds from the (first) snapshot offer to the fastsync
+    handover, measured from real recorder spans — the number bench.py
+    reports as `statesync_bootstrap_ms`.  None unless the full
+    offer→chunk→restore→handover chain is present in order."""
+    first: dict = {}
+    last: dict = {}
+    for ev in events:
+        k = ev.get("kind")
+        if k in STATESYNC_CHAIN:
+            first.setdefault(k, ev["t_ns"])
+            last[k] = ev["t_ns"]
+    if any(k not in first for k in STATESYNC_CHAIN):
+        return None
+    o, c, r, h = (first[STATESYNC_CHAIN[0]], first[STATESYNC_CHAIN[1]],
+                  last[STATESYNC_CHAIN[2]], last[STATESYNC_CHAIN[3]])
+    if not (o <= c <= r <= h):
+        return None
+    return (h - o) / 1e6

@@ -83,7 +83,15 @@ class Client:
         max_clock_drift_ns: int = _DEFAULT_MAX_CLOCK_DRIFT_NS,
         max_retained_headers: int = 0,
         now_fn=time.time_ns,
+        commit_preverify=None,
     ):
+        """`commit_preverify` is an optional async hook
+        `(signed_header, [validator_sets]) -> batch_verify | None` invoked
+        before each commit verification.  Statesync passes an adapter that
+        pre-verifies the whole commit through the node's shared
+        AsyncBatchVerifier (one engine flush per commit — the same ingress
+        consensus votes ride) and returns a cache-lookup batch_verify for
+        the synchronous verify_commit path."""
         if mode not in (SEQUENCE, BISECTION):
             raise ValueError(f"unknown verification mode {mode!r}")
         trust_options.validate()
@@ -97,8 +105,15 @@ class Client:
         self.max_clock_drift_ns = max_clock_drift_ns
         self.max_retained_headers = max_retained_headers
         self.now_fn = now_fn
+        self.commit_preverify = commit_preverify
         self.log = get_logger("lite2")
         self._initialized = False
+
+    async def _bv(self, sh: SignedHeader, vals_sets):
+        """Resolve the batch_verify callable for one commit verification."""
+        if self.commit_preverify is None:
+            return None
+        return await self.commit_preverify(sh, vals_sets)
 
     # -- initialization ----------------------------------------------------
 
@@ -124,7 +139,13 @@ class Client:
         if sh.header.validators_hash != vals.hash():
             raise LightClientError("expected header's validators to match those supplied")
         # self-consistency: +2/3 of its own set signed it (client.go:403)
-        vals.verify_commit(self.chain_id, sh.commit.block_id, sh.height, sh.commit)
+        vals.verify_commit(
+            self.chain_id,
+            sh.commit.block_id,
+            sh.height,
+            sh.commit,
+            batch_verify=await self._bv(sh, [vals]),
+        )
         self.store.save_signed_header_and_validator_set(sh, vals)
         self._initialized = True
 
@@ -197,11 +218,13 @@ class Client:
             verify_adjacent(
                 self.chain_id, t_sh, sh, vals,
                 self.trust_options.period_ns, now, self.max_clock_drift_ns,
+                batch_verify=await self._bv(sh, [vals]),
             )
         else:
             verify_non_adjacent(
                 self.chain_id, t_sh, t_vals, sh, vals,
                 self.trust_options.period_ns, now, self.max_clock_drift_ns, self.trust_level,
+                batch_verify=await self._bv(sh, [vals, t_vals]),
             )
         # witness cross-check BEFORE persisting: a diverged header must
         # never enter the trusted store (client.go:606-612)
@@ -220,6 +243,7 @@ class Client:
             verify_adjacent(
                 self.chain_id, trusted_sh, sh, vals,
                 self.trust_options.period_ns, now, self.max_clock_drift_ns,
+                batch_verify=await self._bv(sh, [vals]),
             )
             self.store.save_signed_header_and_validator_set(sh, vals)
             trusted_sh = sh
@@ -242,6 +266,7 @@ class Client:
                 verify_adjacent(
                     self.chain_id, trusted_sh, untrusted_sh, untrusted_vals,
                     self.trust_options.period_ns, now, self.max_clock_drift_ns,
+                    batch_verify=await self._bv(untrusted_sh, [untrusted_vals]),
                 )
                 verified = True
             else:
@@ -250,6 +275,7 @@ class Client:
                         self.chain_id, trusted_sh, trusted_vals, untrusted_sh, untrusted_vals,
                         self.trust_options.period_ns, now, self.max_clock_drift_ns,
                         self.trust_level,
+                        batch_verify=await self._bv(untrusted_sh, [untrusted_vals, trusted_vals]),
                     )
                     verified = True
                 except ErrNewValSetCantBeTrusted:

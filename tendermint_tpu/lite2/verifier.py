@@ -80,6 +80,7 @@ def verify_non_adjacent(
     now_ns: int,
     max_clock_drift_ns: int,
     trust_level: tuple = DEFAULT_TRUST_LEVEL,
+    batch_verify=None,
 ) -> None:
     """lite2/verifier.go:32 — skipping verification: `trust_level` of the
     validator set we trusted at height T signed the new header at H > T+1,
@@ -99,11 +100,16 @@ def verify_non_adjacent(
             untrusted_sh.commit,
             trust_numerator=trust_level[0],
             trust_denominator=trust_level[1],
+            batch_verify=batch_verify,
         )
     except NotEnoughVotingPowerError as e:
         raise ErrNewValSetCantBeTrusted(e)
     untrusted_vals.verify_commit(
-        chain_id, untrusted_sh.commit.block_id, untrusted_sh.height, untrusted_sh.commit
+        chain_id,
+        untrusted_sh.commit.block_id,
+        untrusted_sh.height,
+        untrusted_sh.commit,
+        batch_verify=batch_verify,
     )
 
 
@@ -115,6 +121,7 @@ def verify_adjacent(
     trusting_period_ns: int,
     now_ns: int,
     max_clock_drift_ns: int,
+    batch_verify=None,
 ) -> None:
     """lite2/verifier.go:96 — sequential verification: H == T+1, so the new
     validator hash must equal the trusted header's NextValidatorsHash."""
@@ -131,7 +138,11 @@ def verify_adjacent(
             f"to match those from new header ({untrusted_sh.header.validators_hash.hex()})"
         )
     untrusted_vals.verify_commit(
-        chain_id, untrusted_sh.commit.block_id, untrusted_sh.height, untrusted_sh.commit
+        chain_id,
+        untrusted_sh.commit.block_id,
+        untrusted_sh.height,
+        untrusted_sh.commit,
+        batch_verify=batch_verify,
     )
 
 

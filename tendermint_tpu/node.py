@@ -76,8 +76,23 @@ class Node(Service):
         self.state_store = StateStore(self.state_db)
 
         self.event_bus = EventBus()
+        # builtin kvstore rides a DURABLE db under home/data (app_db) so a
+        # restart — and statesync crash recovery in particular — finds the
+        # app state it committed; [statesync] snapshot_interval makes it
+        # produce snapshots to serve bootstrapping peers
         creator = client_creator or default_client_creator(
-            config.base.proxy_app, config.base.abci
+            config.base.proxy_app,
+            config.base.abci,
+            # opened only for the builtin kvstore — a socket/gRPC app must
+            # not grow a stray empty db under home/data
+            app_db=(
+                open_db("app", home, backend)
+                if config.base.proxy_app == "kvstore"
+                else None
+            ),
+            snapshot_interval=config.statesync.snapshot_interval,
+            snapshot_chunk_bytes=config.statesync.snapshot_chunk_bytes,
+            snapshot_keep_recent=config.statesync.snapshot_keep_recent,
         )
         self.proxy_app = AppConns(creator)
 
@@ -93,6 +108,7 @@ class Node(Service):
         self.mempool: Optional[Mempool] = None
         self.consensus: Optional[ConsensusState] = None
         self.consensus_reactor = None
+        self.statesync_reactor = None
         self.switch = None
         self.node_key = None
         self.rpc_server = None
@@ -166,9 +182,25 @@ class Node(Service):
         await self.indexer_service.start()
         await self.proxy_app.start()
 
-        # handshake: sync app with block store (node/node.go:601)
-        handshaker = Handshaker(self.state_store, self.state, self.block_store, self.genesis_doc)
-        self.state = await handshaker.handshake(self.proxy_app)
+        # statesync gate, decided BEFORE the handshake: a truly empty node
+        # (no state, no blocks) with [statesync] enable and p2p on will
+        # bootstrap from a snapshot.  The handshake is SKIPPED in that case
+        # (node/node.go: stateSync skips doHandshake): after a crash
+        # between app restore and state persist the app may legitimately
+        # be AHEAD of our empty stores, which the handshake would treat as
+        # corruption — statesync re-offers the snapshot instead.
+        do_state_sync = (
+            cfg.statesync.enable
+            and self.state.last_block_height == 0
+            and self.block_store.height() == 0
+            and bool(cfg.p2p.laddr and cfg.p2p.laddr != "none")
+        )
+        if not do_state_sync:
+            # handshake: sync app with block store (node/node.go:601)
+            handshaker = Handshaker(
+                self.state_store, self.state, self.block_store, self.genesis_doc
+            )
+            self.state = await handshaker.handshake(self.proxy_app)
 
         # mempool (node/node.go:634)
         self.mempool = Mempool(
@@ -290,21 +322,53 @@ class Node(Service):
 
                 self.switch.peer_filters.append(abci_filter)
             from .fastsync import BlockchainReactor
+            from .statesync import StateSyncReactor, StateSyncer
 
             do_fast_sync = cfg.base.fast_sync and not only_validator_is_us(
                 self.state, self.priv_validator
             )
             self.consensus_reactor = ConsensusReactor(
-                self.consensus, wait_sync=do_fast_sync, async_verifier=self.async_verifier
+                self.consensus,
+                wait_sync=do_fast_sync or do_state_sync,
+                async_verifier=self.async_verifier,
             )
-            self.consensus.metrics.fast_syncing.set(1 if do_fast_sync else 0)
+            self.consensus.metrics.fast_syncing.set(1 if (do_fast_sync or do_state_sync) else 0)
             self.blockchain_reactor = BlockchainReactor(
                 self.state,
                 block_exec,
                 self.block_store,
-                fast_sync=do_fast_sync,
+                # while statesync runs, fastsync stays dormant — it must
+                # NOT start replaying from genesis under the restore
+                fast_sync=do_fast_sync and not do_state_sync,
                 consensus_reactor=self.consensus_reactor,
+                wait_statesync=do_state_sync,
             )
+            syncer = None
+            if do_state_sync:
+                syncer = StateSyncer(
+                    cfg.statesync,
+                    self.genesis_doc,
+                    self.state_store,
+                    self.block_store,
+                    self.proxy_app,
+                    async_verifier=self.async_verifier,
+                    metrics=self.metrics_provider.statesync,
+                    recorder=self.flight_recorder,
+                )
+                self.metrics_provider.statesync.sync_phase.set(
+                    self.metrics_provider.statesync.PHASE_STATESYNC
+                )
+            # every node registers the reactor: full nodes SERVE their
+            # app's snapshots on 0x60/0x61 even when not bootstrapping
+            self.statesync_reactor = StateSyncReactor(
+                self.proxy_app, syncer=syncer, on_done=self._statesync_done
+            )
+            self.blockchain_reactor.statesync_metrics = self.metrics_provider.statesync
+            if do_fast_sync and not do_state_sync:
+                self.metrics_provider.statesync.sync_phase.set(
+                    self.metrics_provider.statesync.PHASE_FASTSYNC
+                )
+            self.switch.add_reactor("STATESYNC", self.statesync_reactor)
             self.switch.add_reactor("BLOCKCHAIN", self.blockchain_reactor)
             self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
             # always registered — broadcast=false only disables outbound
@@ -355,6 +419,29 @@ class Node(Service):
             chain_id=self.genesis_doc.chain_id,
             height=self.state.last_block_height,
         )
+
+    async def _statesync_done(self, state) -> None:
+        """Statesync → fastsync handover (or fallback).  `state` is the
+        snapshot-restored state, or None when every candidate failed — in
+        which case fastsync replays from the pre-statesync state (genesis
+        on an empty node) so the node still joins, just slower."""
+        ss_metrics = self.metrics_provider.statesync
+        if state is not None:
+            self.state = state
+            # fresh statesync node: there is no WAL for the restored
+            # height, so consensus must not demand an #ENDHEIGHT marker
+            self.consensus.do_wal_catchup = False
+        else:
+            # fallback to replay-from-genesis: the handshake was SKIPPED
+            # at startup (statesync path), so the app has never seen
+            # InitChain — run it now or the first replayed block executes
+            # against an uninitialized app
+            handshaker = Handshaker(
+                self.state_store, self.state, self.block_store, self.genesis_doc
+            )
+            self.state = await handshaker.handshake(self.proxy_app)
+        ss_metrics.sync_phase.set(ss_metrics.PHASE_FASTSYNC)
+        await self.blockchain_reactor.switch_to_fastsync(self.state)
 
     async def on_stop(self) -> None:
         if self.metrics_server is not None:

@@ -34,12 +34,30 @@ def remote_client_creator(address: str, transport: str = "socket") -> ClientCrea
     return lambda: SocketClient(address)
 
 
-def default_client_creator(address: str, transport: str = "socket") -> ClientCreator:
+def default_client_creator(
+    address: str,
+    transport: str = "socket",
+    app_db=None,
+    snapshot_interval: int = 0,
+    snapshot_chunk_bytes: int = 65536,
+    snapshot_keep_recent: int = 2,
+) -> ClientCreator:
     """proxy/client.go DefaultClientCreator: builtin names get in-proc
     apps, anything else is a socket (or, per config `abci = "grpc"`,
-    gRPC) address."""
+    gRPC) address.  The node passes `app_db` (a KVStore under home/data)
+    so the builtin kvstore survives restarts — required for statesync
+    crash recovery, where the restored app state must outlive the
+    process — plus the `[statesync] snapshot_interval` producing
+    snapshots every N heights."""
     if address == "kvstore":
-        return local_client_creator(KVStoreApplication())
+        return local_client_creator(
+            KVStoreApplication(
+                db=app_db,
+                snapshot_interval=snapshot_interval,
+                snapshot_chunk_bytes=snapshot_chunk_bytes,
+                snapshot_keep_recent=snapshot_keep_recent,
+            )
+        )
     if address == "counter":
         return local_client_creator(CounterApplication())
     if address == "counter_serial":

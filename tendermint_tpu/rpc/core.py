@@ -154,17 +154,32 @@ class RPCCore:
         bs = node.block_store
         latest_height = bs.height()
         meta = bs.load_block_meta(latest_height) if latest_height else None
+        # actual sync phase: statesync (snapshot restore in flight) →
+        # fastsync (block replay tail) → caught_up.  `catching_up` used to
+        # reflect only the fastsync flag, hiding statesync from readiness
+        # gates and dashboards.
+        ss = getattr(node, "statesync_reactor", None)
+        br = getattr(node, "blockchain_reactor", None)
+        if ss is not None and getattr(ss, "syncing", False):
+            phase = "statesync"
+        elif br is not None and (
+            getattr(br, "fast_sync", False) or getattr(br, "wait_statesync", False)
+        ):
+            phase = "fastsync"
+        else:
+            phase = "caught_up"
         sync_info = {
             "latest_block_hash": meta.block_id.hash if meta else b"",
             "latest_app_hash": meta.header.app_hash if meta else b"",
             "latest_block_height": latest_height,
             "latest_block_time_ns": meta.header.time_ns if meta else 0,
             "earliest_block_height": bs.base(),
-            "catching_up": bool(
-                getattr(node, "blockchain_reactor", None)
-                and getattr(node.blockchain_reactor, "fast_sync", False)
-            ),
+            "catching_up": phase != "caught_up",
+            "sync_phase": phase,
         }
+        if ss is not None and ss.syncer is not None:
+            applied, total = ss.syncer.progress
+            sync_info["statesync"] = {"chunks_applied": applied, "chunks_total": total}
         validator_info = {}
         if node.priv_validator is not None:
             pub = node.priv_validator.get_pub_key()

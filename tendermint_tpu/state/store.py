@@ -65,6 +65,20 @@ class StateStore:
             state = make_genesis_state(gen_doc)
         return state
 
+    def bootstrap(self, state: State) -> None:
+        """state/store.go Bootstrap — persist a statesync-restored state
+        whose history does NOT exist locally: full (non-pointer) validator
+        records for the heights consensus and RPC will touch next, plus a
+        full consensus-params record, so the pointer-to-last-changed
+        scheme never dereferences a height below the snapshot."""
+        h = state.last_block_height
+        if state.last_validators is not None and state.last_validators.size() > 0:
+            self._save_validators(h, h, state.last_validators)
+        self._save_validators(h + 1, h + 1, state.validators)
+        self._save_validators(h + 2, h + 2, state.next_validators)
+        self._save_params(h + 1, h + 1, state.consensus_params)
+        self.db.set(_K_STATE, state.bytes())
+
     # -- historical validator sets ----------------------------------------
     # Full-set checkpoint cadence for unchanged validator sets (reference
     # valSetCheckpointInterval, state/store.go:42, shrunk for Python):

@@ -1,0 +1,18 @@
+"""State sync: bootstrap a fresh node from a peer-served app snapshot.
+
+The subsystem that turns the repo's two trust machines — the lite2
+skipping-verification light client and the TPU batch-verify engine —
+into a bootstrap path: instead of replaying every block from genesis, a
+joining node restores a chunked application snapshot whose app hash is
+checked against a lite2-verified header (commits batch-verified through
+the shared engine), then fastsyncs only the tail.
+"""
+
+from .chunker import ChunkScheduler  # noqa: F401
+from .reactor import CHUNK_CHANNEL, SNAPSHOT_CHANNEL, StateSyncReactor  # noqa: F401
+from .syncer import (  # noqa: F401
+    EngineCommitPreverify,
+    SnapshotRejectedError,
+    StateSyncError,
+    StateSyncer,
+)

@@ -127,6 +127,30 @@ class BlockStore:
             self._height = height
             self._save_state()
 
+    def bootstrap_light_block(self, header: Header, block_id: BlockID, seen_commit: Commit) -> None:
+        """Statesync bootstrap (store/store.go SaveSeenCommit flavor):
+        persist the lite2-verified header + its commit at the snapshot
+        height into an EMPTY store, so consensus can reconstruct the last
+        commit and RPC `/commit` can serve the trust root to other light
+        clients.  No block parts exist — `load_block` at this height stays
+        None and fastsync serves `no_block_response` for it."""
+        height = header.height
+        with self._mtx:
+            if self._height != 0:
+                raise ValueError(
+                    f"cannot bootstrap light block at {height}: store already at {self._height}"
+                )
+            meta = BlockMeta(block_id, 0, header, 0)
+            self.db.write_batch([
+                (_k_meta(height), codec.dumps(meta)),
+                (_k_block_hash(block_id.hash), b"%d" % height),
+                (_k_commit(height), codec.dumps(seen_commit)),
+                (_k_seen_commit(height), codec.dumps(seen_commit)),
+            ])
+            self._base = height
+            self._height = height
+            self._save_state()
+
     # -- loading -----------------------------------------------------------
     def load_block_meta(self, height: int) -> Optional[BlockMeta]:
         raw = self.db.get(_k_meta(height))
