@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench bench-smoke trace-smoke statesync-smoke localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke trace-smoke statesync-smoke chaos-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -24,6 +24,10 @@ trace-smoke:     ## short localnet; fails unless every block has a complete prop
 statesync-smoke: ## empty 4th node joins a 3-val localnet via snapshot restore (fails on genesis replay)
 	$(PY) networks/local/statesync_smoke.py --json
 	rm -rf build-statesync
+
+chaos-smoke:     ## scripted partition/kill/twin scenario on a 4-val localnet; fails on any invariant violation
+	$(PY) networks/local/chaos_smoke.py --json
+	rm -rf build-chaos
 
 localnet:        ## 4-validator net as OS processes (no docker)
 	$(PY) -m tendermint_tpu.cli testnet --validators 4 --output ./build

@@ -356,6 +356,30 @@ def bench_e2e_4val_procs(duration: float = 12.0):
         return json.loads(run.stdout.strip().splitlines()[-1])
 
 
+def bench_chaos_recovery():
+    """Chaos engine acceptance as a number: run the scripted
+    partition/kill/twin scenario (networks/local/chaos_smoke.py) and
+    report `chaos_partition_recovery_ms` — wall milliseconds from the
+    partition healing to the first new commit, measured by the invariant
+    checker while it also proves agreement, no-regression, restart
+    recovery, and twin-evidence accountability.  Raises if any invariant
+    failed."""
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as tmp:
+        run = subprocess.run(
+            [sys.executable, os.path.join(repo, "networks", "local", "chaos_smoke.py"),
+             "--build-dir", os.path.join(tmp, "build"), "--base-port", "30756", "--json"],
+            capture_output=True, text=True, timeout=420, cwd=repo,
+        )
+        if run.returncode != 0:
+            raise RuntimeError(f"chaos smoke failed:\n{run.stdout}\n{run.stderr}")
+        return json.loads(run.stdout.strip().splitlines()[-1])
+
+
 def bench_statesync_bootstrap():
     """Statesync bootstrap time, measured from REAL recorder spans: an
     empty 4th node joins a live 3-validator localnet via snapshot restore
@@ -620,6 +644,10 @@ def main() -> None:
         statesync = bench_statesync_bootstrap()
     except Exception as e:
         statesync = {"statesync_bootstrap_ms": -1.0, "error": str(e)[:300]}
+    try:
+        chaos = bench_chaos_recovery()
+    except Exception as e:
+        chaos = {"chaos_partition_recovery_ms": -1.0, "error": str(e)[:300]}
     extras = {
         "commit_verify_100val_ms": bench_100val_commit(),
         "e2e_commits_per_sec_solo": asyncio.run(bench_e2e_commits()),
@@ -652,6 +680,9 @@ def main() -> None:
         "e2e_4val_procs_startup_s": procs.get("startup_s"),
         "statesync_bootstrap_ms": statesync.get("statesync_bootstrap_ms", -1.0),
         "statesync_bootstrap_wall_s": statesync.get("bootstrap_wall_s"),
+        "chaos_partition_recovery_ms": chaos.get("chaos_partition_recovery_ms", -1.0),
+        "chaos_restart_recovery_ms": chaos.get("restart_recovery_ms"),
+        "chaos_evidence_height": chaos.get("evidence_height"),
         "vote_hop_flush_ms": round(hop_ms, 3),
         "e2e_4val_recorder": procs.get("recorder"),
         "e2e_4val_breakdown": _e2e_breakdown(procs, hop_ms),

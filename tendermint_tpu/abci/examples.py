@@ -103,6 +103,22 @@ class KVStoreApplication(t.Application):
 
     def begin_block(self, req: t.RequestBeginBlock) -> t.ResponseBeginBlock:
         self._pending_updates = []
+        if req.byzantine_validators:
+            # Record evidence delivery in app state (deterministic: derived
+            # from the committed block, identical on every node; excluded
+            # from app_hash, which commits only to (tx_count, height)).
+            # This is how the chaos checker PROVES the accountability
+            # pipeline reached ABCI: query data=b"__byzantine__" returns
+            # the hex addresses BeginBlock reported.
+            key = b"kv:__byzantine__"
+            existing = self.db.get(key)
+            addrs = set(existing.split(b",")) if existing else set()
+            for ev in req.byzantine_validators:
+                addr = ev.get("address", b"") if isinstance(ev, dict) else b""
+                if isinstance(addr, bytes) and addr:
+                    addrs.add(addr.hex().encode())
+            if addrs:
+                self.db.set(key, b",".join(sorted(addrs)))
         return t.ResponseBeginBlock()
 
     def _is_validator_tx(self, tx: bytes) -> bool:

@@ -1,0 +1,52 @@
+"""Chaos engine: deterministic fault injection + BFT invariant checking.
+
+No reference counterpart as a subsystem — the reference scatters its fault
+machinery across p2p/fuzz.go (probabilistic link chaos), libs/fail
+(crash points), the byzantine consensus tests and the Jepsen-style
+`test/` harness.  Here the pieces are one package with one contract:
+every fault is SEEDED and REPLAYABLE, every run is judged by the same
+invariant checker, and both the in-process net (tier-1 tests) and the
+multi-process localnet rig (`make chaos-smoke`) are driven by the same
+scenario schedule.
+
+Pieces:
+
+  link.py      per-link LinkPolicy (directional drop/delay/throttle between
+               named peers) + LinkPolicyTable, the runtime-controllable
+               upgrade of p2p/fuzz.py — partitions can form and HEAL mid-run
+  clock.py     pluggable consensus time source + per-node skew injection
+  twin.py      TwinSigner: a privval that bypasses the last-sign-state
+               guard and equivocates, driving the full accountability
+               pipeline (VoteSet conflict -> EvidencePool -> block ->
+               BeginBlock byzantine_validators)
+  scenario.py  declarative seeded fault timelines + the async runner and
+               the in-process rig
+  checker.py   Jepsen-flavor invariant checker: agreement, no height
+               regression, bounded recovery, accountability
+
+Faults are injected only when `[chaos] enabled` is on (config) or a test
+holds direct handles; the unsafe RPC control routes additionally require
+`rpc.unsafe`.
+"""
+
+from .checker import InvariantChecker, RecoveryTimer
+from .clock import Clock, SkewedClock, SYSTEM_CLOCK
+from .link import LinkPolicy, LinkPolicyTable
+from .scenario import FaultEvent, InProcRig, Scenario, ScenarioRunner
+from .twin import TwinSigner, install_twin
+
+__all__ = [
+    "Clock",
+    "FaultEvent",
+    "InProcRig",
+    "InvariantChecker",
+    "LinkPolicy",
+    "LinkPolicyTable",
+    "RecoveryTimer",
+    "Scenario",
+    "ScenarioRunner",
+    "SkewedClock",
+    "SYSTEM_CLOCK",
+    "TwinSigner",
+    "install_twin",
+]

@@ -1,0 +1,61 @@
+"""Pluggable consensus time source + per-node clock-skew injection.
+
+The consensus state machine reads time through exactly one object (its
+`clock` attribute) instead of the `time` module, so a chaos scenario can
+skew ONE node's notion of wall-clock time — the fault class behind
+BFT-time median drift, propose-side drift rejections (prevote nil on
+future-dated proposals) and lite2 max_clock_drift violations — without
+touching the process clock or any other node.
+
+Only the WALL clock (`time_ns`) skews.  `monotonic` stays honest: it
+feeds timeout scheduling and span math, where a skew would not model a
+wrong wall clock but a broken CPU — a different (and uninteresting)
+failure.  This mirrors how real clock skew behaves: NTP drift moves your
+timestamps, not your interval timers.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    """The honest system clock — consensus' default time source."""
+
+    def time_ns(self) -> int:
+        return time.time_ns()
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+
+SYSTEM_CLOCK = Clock()
+
+
+class SkewedClock(Clock):
+    """Wall clock offset by a runtime-adjustable skew (seconds; may be
+    negative).  Installed on a node's ConsensusState by the chaos config
+    (`[chaos] clock_skew`) or the `unsafe_chaos_clock_skew` RPC route."""
+
+    def __init__(self, skew_s: float = 0.0, metrics=None, recorder=None):
+        self.skew_ns = int(skew_s * 1e9)
+        self.metrics = metrics
+        self.recorder = recorder
+        self._publish(skew_s)
+
+    def set_skew(self, skew_s: float) -> None:
+        self.skew_ns = int(skew_s * 1e9)
+        self._publish(skew_s)
+
+    @property
+    def skew_s(self) -> float:
+        return self.skew_ns / 1e9
+
+    def _publish(self, skew_s: float) -> None:
+        if self.metrics is not None:
+            self.metrics.clock_skew_seconds.set(skew_s)
+        if self.recorder is not None:
+            self.recorder.record("chaos.skew", skew_s=skew_s)
+
+    def time_ns(self) -> int:
+        return time.time_ns() + self.skew_ns

@@ -119,6 +119,15 @@ def cmd_testnet(args) -> int:
     out = os.path.abspath(args.output)
     chain_id = args.chain_id or f"testnet-{os.urandom(3).hex()}"
     fast = getattr(args, "fast", False)
+    chaos = getattr(args, "chaos", False)
+    twin = getattr(args, "twin", -1)
+    if not chaos and (twin >= 0 or getattr(args, "chaos_seed", 0)):
+        # fail NOW, not minutes later with "twin evidence never committed"
+        print("--twin / --chaos-seed require --chaos", file=sys.stderr)
+        return 2
+    if twin >= n:
+        print(f"--twin {twin} out of range for {n} validators", file=sys.stderr)
+        return 2
     homes, pvs, node_keys = [], [], []
     for i in range(n):
         home = os.path.join(out, f"node{i}")
@@ -182,6 +191,13 @@ def cmd_testnet(args) -> int:
             cfg.consensus.peer_query_maj23_sleep_duration = 0.25
         elif args.db_backend:
             cfg.base.db_backend = args.db_backend
+        if chaos:
+            # chaos rig: fault layer + guarded control routes on every
+            # node; node --twin becomes a double-signer from genesis
+            cfg.chaos.enabled = True
+            cfg.chaos.seed = getattr(args, "chaos_seed", 0)
+            cfg.chaos.twin = i == twin
+            cfg.rpc.unsafe = True
         _write_cfg(cfg)
         genesis.save_as(cfg.genesis_file())
     print(f"Successfully initialized {n} node directories in {out} (chain_id={chain_id})")
@@ -423,6 +439,20 @@ def build_parser() -> argparse.ArgumentParser:
         "time_iota_ms=1 genesis, memdb",
     )
     sp.add_argument("--db-backend", choices=["sqlite", "memdb"], default="")
+    sp.add_argument(
+        "--chaos",
+        action="store_true",
+        help="chaos rig: enable the fault-injection layer and the unsafe "
+        "chaos control RPC routes on every node",
+    )
+    sp.add_argument(
+        "--chaos-seed", type=int, default=0,
+        help="seed for every probabilistic fault decision (replayable runs)",
+    )
+    sp.add_argument(
+        "--twin", type=int, default=-1,
+        help="node index to run as a double-signing twin (requires --chaos)",
+    )
     sp.set_defaults(fn=cmd_testnet)
 
     sp = sub.add_parser("gen_validator", help="generate a validator keypair")

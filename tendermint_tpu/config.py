@@ -203,6 +203,27 @@ class TPUConfig:
 
 
 @dataclass
+class ChaosConfig:
+    """Deterministic fault injection (chaos/ package; no reference
+    counterpart — the reference scatters this across p2p/fuzz.go, the
+    byzantine tests and the external Jepsen harness).
+
+    With `enabled`, the node builds a runtime-controllable LinkPolicyTable
+    (per-peer directional drop/delay/throttle — partitions that can form
+    and HEAL), exposes the `unsafe_chaos_*` RPC control routes (which
+    additionally require rpc.unsafe), honors `clock_skew`, and — with
+    `twin` — wraps its privval in a TwinSigner that BYPASSES the
+    double-sign guard and equivocates on prevotes from genesis.  Never
+    enable on a production node; `twin` is the attack the accountability
+    pipeline slashes."""
+
+    enabled: bool = False
+    seed: int = 0  # drives every probabilistic fault decision + jitter
+    twin: bool = False  # this node double-signs (requires enabled)
+    clock_skew: float = 0.0  # seconds added to this node's consensus wall clock
+
+
+@dataclass
 class TxIndexConfig:
     indexer: str = "kv"  # kv | null
 
@@ -233,6 +254,7 @@ class Config:
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
 
@@ -311,6 +333,10 @@ class Config:
             raise ValueError("statesync.snapshot_keep_recent must be >= 1")
         if ss.chunk_fetch_retries < 0:
             raise ValueError("statesync.chunk_fetch_retries can't be negative")
+        if self.chaos.twin and not self.chaos.enabled:
+            raise ValueError("chaos.twin requires chaos.enabled")
+        if self.chaos.clock_skew != 0.0 and not self.chaos.enabled:
+            raise ValueError("chaos.clock_skew requires chaos.enabled")
 
 
 def default_config(home: str = "~/.tendermint_tpu") -> Config:
@@ -360,6 +386,7 @@ def save_config(cfg: Config, path: str) -> None:
         "statesync": cfg.statesync,
         "consensus": cfg.consensus,
         "tpu": cfg.tpu,
+        "chaos": cfg.chaos,
         "tx_index": cfg.tx_index,
         "instrumentation": cfg.instrumentation,
     }
@@ -407,6 +434,7 @@ def load_config(path: str, home: Optional[str] = None) -> Config:
     apply(cfg.statesync, data.get("statesync", {}))
     apply(cfg.consensus, data.get("consensus", {}))
     apply(cfg.tpu, data.get("tpu", {}))
+    apply(cfg.chaos, data.get("chaos", {}))
     apply(cfg.tx_index, data.get("tx_index", {}))
     apply(cfg.instrumentation, data.get("instrumentation", {}))
     return cfg

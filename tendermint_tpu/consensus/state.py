@@ -105,6 +105,13 @@ class ConsensusState(Service):
         self.metrics = ConsensusMetrics()  # nop; node swaps in prometheus
         self.recorder = tracing.NOP  # node swaps in its FlightRecorder
         self._total_txs = 0
+        # Pluggable time source (chaos/clock.py): every wall-clock and
+        # monotonic read in the state machine goes through this object, so
+        # fault injection can skew ONE node's clock ([chaos] clock_skew /
+        # unsafe_chaos_clock_skew) without touching the process or peers.
+        from ..chaos.clock import SYSTEM_CLOCK
+
+        self.clock = SYSTEM_CLOCK
 
         # the round state
         self.rs = RoundState()
@@ -357,7 +364,7 @@ class ConsensusState(Service):
         if self.rs.step == RoundStep.NEW_HEIGHT:
             if self._need_proof_block(self.rs.height):
                 return
-            timeout_commit = self.rs.start_time - time.monotonic() + 0.001
+            timeout_commit = self.rs.start_time - self.clock.monotonic() + 0.001
             self._schedule_timeout(timeout_commit, self.rs.height, 0, RoundStep.NEW_ROUND)
         elif self.rs.step == RoundStep.NEW_ROUND:
             await self.enter_propose(self.rs.height, 0)
@@ -459,7 +466,7 @@ class ConsensusState(Service):
             round=round_,
             pol_round=rs.valid_round,
             block_id=prop_block_id,
-            timestamp_ns=time.time_ns(),
+            timestamp_ns=self.clock.time_ns(),
         )
         try:
             await _maybe_await(self.priv_validator.sign_proposal(self.sm_state.chain_id, proposal))
@@ -541,7 +548,7 @@ class ConsensusState(Service):
         # now + drift would commit a block every light client rejects —
         # refuse it here, at prevote, before it can gather a polka.
         drift_ns = int(self.config.proposal_clock_drift * 1e9)
-        if drift_ns > 0 and rs.proposal_block.time_ns > time.time_ns() + drift_ns:
+        if drift_ns > 0 and rs.proposal_block.time_ns > self.clock.time_ns() + drift_ns:
             self.log.error(
                 "prevote: ProposalBlock time too far in the future",
                 block_time_ns=rs.proposal_block.time_ns,
@@ -682,7 +689,7 @@ class ConsensusState(Service):
         finally:
             self._update_round_step(rs.round, RoundStep.COMMIT)
             rs.commit_round = commit_round
-            rs.commit_time = time.monotonic()
+            rs.commit_time = self.clock.monotonic()
             await self._new_step()
             await self.try_finalize_commit(height)
 
@@ -1023,7 +1030,7 @@ class ConsensusState(Service):
 
     def _vote_time(self) -> int:
         """BFT-time monotonicity (state.go:1952)."""
-        now = time.time_ns()
+        now = self.clock.time_ns()
         min_time = now
         iota_ns = self.sm_state.consensus_params.block.time_iota_ms * 1_000_000
         if self.rs.locked_block is not None:
@@ -1088,7 +1095,7 @@ class ConsensusState(Service):
         height = state.last_block_height + 1
         rs.height = height
         self._update_round_step(0, RoundStep.NEW_HEIGHT)
-        now = time.monotonic()
+        now = self.clock.monotonic()
         base = rs.commit_time if rs.commit_time else now
         rs.start_time = self.config.commit(base)
         rs.validators = state.validators
@@ -1126,7 +1133,7 @@ class ConsensusState(Service):
 
     def schedule_round0(self) -> None:
         """state.go:466 — enter_new_round(height, 0) at start_time."""
-        sleep = self.rs.start_time - time.monotonic()
+        sleep = self.rs.start_time - self.clock.monotonic()
         self._schedule_timeout(sleep, self.rs.height, 0, RoundStep.NEW_HEIGHT)
 
     def _schedule_timeout(self, duration: float, height: int, round_: int, step: int) -> None:

@@ -33,6 +33,18 @@ class EvidencePool:
         self.log = get_logger("evidence")
         # new-evidence callbacks (reactor gossip hook)
         self.on_evidence = []
+        # observability (node swaps in prometheus + its FlightRecorder);
+        # the pool used to be invisible — the accountability pipeline's
+        # middle leg left no telemetry between detection and block
+        from .libs import tracing
+        from .libs.metrics import EvidenceMetrics
+
+        self.metrics = EvidenceMetrics()
+        self.recorder = tracing.NOP
+        # pending count maintained incrementally (one scan at open, ±1 on
+        # add/commit/prune) — the gauge must not cost a full prefix scan
+        # per event on the commit path
+        self._n_pending = sum(1 for _ in self.db.iterate_prefix(b"evp/"))
 
     def set_state(self, state) -> None:
         self.state = state
@@ -46,8 +58,16 @@ class EvidencePool:
             verify_evidence(self.state, ev, self.state_store)
         self.db.set(_k_pending(ev.height(), ev.hash()), codec.dumps(ev))
         self.log.info("verified new evidence of byzantine behaviour", evidence=repr(ev))
+        self.recorder.record(
+            "evidence.add", height=ev.height(), hash=ev.hash().hex()[:16]
+        )
+        self._n_pending += 1
+        self.metrics.pending.set(self._n_pending)
         for cb in self.on_evidence:
             cb(ev)
+
+    def num_pending(self) -> int:
+        return self._n_pending
 
     # -- queries -----------------------------------------------------------
     def pending_evidence(self, max_num: int = -1) -> List[Evidence]:
@@ -75,10 +95,20 @@ class EvidencePool:
         self._prune_expired(state)
 
     def mark_committed(self, ev: Evidence) -> None:
+        already = self.is_committed(ev)
+        was_pending = self.is_pending(ev)
         self.db.write_batch(
             [(_k_committed(ev.hash()), b"1")],
             deletes=[_k_pending(ev.height(), ev.hash())],
         )
+        if was_pending:
+            self._n_pending -= 1
+        if not already:
+            self.metrics.committed.inc()
+            self.recorder.record(
+                "evidence.commit", height=ev.height(), hash=ev.hash().hex()[:16]
+            )
+        self.metrics.pending.set(self._n_pending)
 
     def _prune_expired(self, state) -> None:
         params = state.consensus_params.evidence
@@ -91,6 +121,8 @@ class EvidencePool:
                 deletes.append(key)
         if deletes:
             self.db.write_batch([], deletes)
+            self._n_pending -= len(deletes)
+            self.metrics.pending.set(self._n_pending)
 
 
 class NopEvidencePool:

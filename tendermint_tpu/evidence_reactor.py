@@ -80,7 +80,14 @@ class EvidenceReactor(Reactor):
             wake.clear()  # before scanning, so adds during the scan re-set it
             peer_h = self._peer_height(peer)
             fresh, withheld = [], False
-            for ev in self.pool.pending_evidence():
+            pending = self.pool.pending_evidence()
+            # Bound the sent set: an entry is only needed while the
+            # evidence can still be re-scanned, i.e. while it is pending.
+            # Once committed or expired it leaves the pool and can never
+            # be re-sent, so its hash is dead weight — on a long-lived
+            # peer the set used to grow forever.
+            sent.intersection_update(ev.hash() for ev in pending)
+            for ev in pending:
                 if ev.hash() in sent:
                     continue
                 if ev.height() <= peer_h:

@@ -325,6 +325,73 @@ class StateSyncMetrics:
         ).labels(chain_id=chain_id)
 
 
+class EvidenceMetrics:
+    """Evidence pool observability (subsystem `evidence`; the reference
+    has none — its pool is invisible).  `pending` tracks the number of
+    uncommitted evidence items in the pool; `committed` counts evidence
+    that made it into a block (the accountability pipeline's terminal
+    proof) — exposed as `tendermint_evidence_committed_total`."""
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        if registry is None:
+            self.pending = _NOP
+            self.committed = _NOP
+            return
+        from prometheus_client import Counter, Gauge
+
+        kw = dict(namespace=NAMESPACE, subsystem="evidence", registry=registry,
+                  labelnames=("chain_id",))
+        self.pending = Gauge(
+            "pending", "Uncommitted evidence items in the pool.", **kw
+        ).labels(chain_id=chain_id)
+        self.committed = Counter(
+            "committed", "Evidence items committed into blocks.", **kw
+        ).labels(chain_id=chain_id)
+
+
+class ChaosMetrics:
+    """Fault-injection telemetry (subsystem `chaos`; only populated when
+    `[chaos] enabled`).  The injected-fault counters make a chaos run
+    diagnosable from the same scrape as production telemetry: a stalled
+    net with `links_degraded` > 0 is a staged partition, with 0 it's a
+    real bug."""
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        if registry is None:
+            for name in (
+                "links_degraded", "msgs_dropped", "msgs_delayed",
+                "clock_skew_seconds", "twin_votes",
+            ):
+                setattr(self, name, _NOP)
+            return
+        from prometheus_client import Counter, Gauge
+
+        kw = dict(namespace=NAMESPACE, subsystem="chaos", registry=registry,
+                  labelnames=("chain_id",))
+
+        def g(name, doc):
+            return Gauge(name, doc, **kw).labels(chain_id=chain_id)
+
+        def c(name, doc):
+            return Counter(name, doc, **kw).labels(chain_id=chain_id)
+
+        self.links_degraded = g(
+            "links_degraded", "Outbound links with an active fault policy."
+        )
+        self.msgs_dropped = c(
+            "msgs_dropped", "Messages refused by an injected drop policy."
+        )
+        self.msgs_delayed = c(
+            "msgs_delayed", "Messages delayed or throttled by a link policy."
+        )
+        self.clock_skew_seconds = g(
+            "clock_skew_seconds", "Injected consensus wall-clock skew."
+        )
+        self.twin_votes = c(
+            "twin_votes", "Conflicting votes signed by the twin double-signer."
+        )
+
+
 class MetricsProvider:
     """node/node.go:128 DefaultMetricsProvider — one registry per node."""
 
@@ -342,6 +409,8 @@ class MetricsProvider:
         self.state = StateMetrics(self.registry, chain_id)
         self.verify = VerifyMetrics(self.registry, chain_id)
         self.statesync = StateSyncMetrics(self.registry, chain_id)
+        self.evidence = EvidenceMetrics(self.registry, chain_id)
+        self.chaos = ChaosMetrics(self.registry, chain_id)
 
     def exposition(self) -> bytes:
         if self.registry is None:

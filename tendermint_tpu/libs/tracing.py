@@ -33,6 +33,16 @@ Event kinds currently emitted:
     statesync.chunk   index, total, peer       chunk hash-verified + applied
     statesync.restore height, ms               app restored + checked vs verified header
     statesync.handover  height                 restored state handed to fastsync
+  evidence (evidence.py, accountability pipeline):
+    evidence.add      height, hash             evidence verified into the pool
+    evidence.commit   height, hash             evidence committed into a block
+  chaos (chaos/ package, fault injection — only when [chaos] enabled):
+    chaos.link        peer, drop, delay, ...   a link policy was set
+    chaos.heal                                 every link policy cleared
+    chaos.skew        skew_s                   consensus wall-clock skew set
+    chaos.twin_vote   height, round, type      the twin signed a conflict
+    chaos.partition / chaos.kill / chaos.restart ...  scenario events as
+                                               executed by the runner
 
 Events are flat dicts: {"seq", "t_ns", "kind", **fields}.  `t_ns` is
 time.monotonic_ns() — deltas are meaningful, wall-clock is not.

@@ -67,6 +67,13 @@ class Node(Service):
         genesis_doc.validate_and_complete()
         self.genesis_doc = genesis_doc
         self.priv_validator = priv_validator
+        if config.chaos.enabled and config.chaos.twin and priv_validator is not None:
+            # chaos: this node is a byzantine TWIN — its privval bypasses
+            # the double-sign guard; install_twin (on_start) makes it
+            # equivocate on prevotes from genesis
+            from .chaos.twin import TwinSigner
+
+            self.priv_validator = TwinSigner(priv_validator)
         self.log = get_logger("node")
 
         backend = db_backend or config.base.db_backend
@@ -222,6 +229,12 @@ class Node(Service):
         self.evidence_pool = EvidencePool(
             open_db("evidence", home, cfg.base.db_backend), self.state_store
         )
+        self.evidence_pool.metrics = self.metrics_provider.evidence
+        self.evidence_pool.recorder = self.flight_recorder
+        # re-publish the opening count: the pool counted pending evidence
+        # against its nop metrics before this swap — a restart with a
+        # backlog must not scrape as 0 until the next pool event
+        self.evidence_pool.metrics.pending.set(self.evidence_pool.num_pending())
 
         self.mempool.metrics = self.metrics_provider.mempool
 
@@ -245,6 +258,17 @@ class Node(Service):
         )
         self.consensus.metrics = self.metrics_provider.consensus
         self.consensus.recorder = self.flight_recorder
+        self.chaos_clock = None
+        if cfg.chaos.enabled and cfg.chaos.clock_skew != 0.0:
+            # chaos: this node's consensus reads a skewed wall clock
+            from .chaos.clock import SkewedClock
+
+            self.chaos_clock = SkewedClock(
+                cfg.chaos.clock_skew,
+                metrics=self.metrics_provider.chaos,
+                recorder=self.flight_recorder,
+            )
+            self.consensus.clock = self.chaos_clock
         if self.priv_validator is not None:
             self.consensus.set_priv_validator(self.priv_validator)
         cfg.ensure_dirs()
@@ -287,7 +311,31 @@ class Node(Service):
             )
             transport = Transport(self.node_key, node_info)
             fuzz_config = None
-            if cfg.p2p.test_fuzz:  # p2p/fuzz.go — soak-test chaos wrapper
+            link_policies = None
+            if cfg.chaos.enabled:
+                # chaos: runtime-controllable per-link fault layer; starts
+                # with healthy links (a legacy test_fuzz config seeds the
+                # wildcard loss policy on top)
+                from .chaos.link import LinkPolicyTable
+                from .p2p.fuzz import table_from_fuzz_config
+
+                if cfg.p2p.test_fuzz:
+                    link_policies = table_from_fuzz_config(
+                        {
+                            "prob_drop_rw": cfg.p2p.test_fuzz_prob_drop,
+                            "max_delay": cfg.p2p.test_fuzz_max_delay,
+                            "seed": cfg.chaos.seed,
+                        },
+                        metrics=self.metrics_provider.chaos,
+                        recorder=self.flight_recorder,
+                    )
+                else:
+                    link_policies = LinkPolicyTable(
+                        seed=cfg.chaos.seed,
+                        metrics=self.metrics_provider.chaos,
+                        recorder=self.flight_recorder,
+                    )
+            elif cfg.p2p.test_fuzz:  # p2p/fuzz.go — soak-test chaos wrapper
                 fuzz_config = {
                     "prob_drop_rw": cfg.p2p.test_fuzz_prob_drop,
                     "max_delay": cfg.p2p.test_fuzz_max_delay,
@@ -297,6 +345,7 @@ class Node(Service):
                 max_inbound=cfg.p2p.max_num_inbound_peers,
                 max_outbound=cfg.p2p.max_num_outbound_peers,
                 fuzz_config=fuzz_config,
+                link_policies=link_policies,
                 unconditional_peer_ids={
                     s for s in cfg.p2p.unconditional_peer_ids.split(",") if s
                 },
@@ -399,6 +448,12 @@ class Node(Service):
             # advertise the actually-bound address (PEX peers gossip it)
             node_info.listen_addr = cfg.p2p.external_address or transport.listen_addr
             await self.switch.start()  # starts reactors, incl. consensus
+            if cfg.chaos.enabled and cfg.chaos.twin and self.priv_validator is not None:
+                # arm the twin AFTER the switch is live: its equivocations
+                # broadcast over the consensus vote channel
+                from .chaos.twin import install_twin
+
+                install_twin(self)
             if cfg.p2p.persistent_peers:
                 await self.switch.dial_peers_async(
                     cfg.p2p.persistent_peers.split(","), persistent=True

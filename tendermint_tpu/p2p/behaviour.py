@@ -61,6 +61,8 @@ class SwitchReporter:
                 self.switch.addr_book.mark_good(behaviour.peer_id)
             return True
         if behaviour.kind in _BAD:
+            # stop_peer_for_error feeds the trust store (mark_failed);
+            # the decayed score then demotes the peer in dial selection
             await self.switch.stop_peer_for_error(peer, behaviour.explanation)
             return True
         raise ValueError(f"unknown behaviour kind {behaviour.kind!r}")

@@ -3,71 +3,69 @@
 Reference parity: p2p/fuzz.go:14 FuzzedConnection (ProbDropRW / MaxDelay)
 — config-gated chaos for soak tests.
 
-Redesign: the reference wraps the raw net.Conn; under our SecretConnection
-a byte-level drop desyncs the AEAD stream, and under MConnection a
-packet-level drop corrupts multi-packet message reassembly — both turn
-"loss" into instant connection death, which tests reconnect but not
-protocol liveness under loss.  Here the fuzz sits at the CHANNEL MESSAGE
-boundary: whole gossip messages are refused or delayed, framing stays
-intact, and the consensus/mempool/evidence reactors must survive the loss
-by retransmission — the property the soak is after.  (Connection churn
-itself is covered separately: dropped-link reconnect is exercised by the
-crash/recovery suite.)
+This module is now a thin compatibility surface over the chaos engine's
+per-link policy layer (chaos/link.py).  The original PeerFuzz was one
+immutable probability applied to every peer for the life of the node —
+enough for the loss soak, but it could not stage a partition, heal one,
+or degrade a single named link; LinkPolicyTable can, at runtime, and the
+switch installs IT.  `p2p.test_fuzz` configs keep working: the node maps
+them to a wildcard LinkPolicy(drop=prob_drop, jitter=max_delay).
 
-A dropped send REPORTS FAILURE (returns False) instead of silently
-swallowing the message: tendermint gossip runs over TCP, so its peer-state
-bookkeeping assumes sent == will-be-delivered unless the connection dies.
-A silent drop that still reports success plants a phantom "peer has this
-part/vote" bit; votes have a repair channel (VoteSetMaj23/VoteSetBits
-resync) but block-part bitmaps deliberately have none, so one phantom part
-can wedge a catching-up peer forever — a failure mode the real transport
-cannot produce.  Reporting failure models a transient send refusal, which
-every gossip loop already handles by re-picking and retrying.
+Design notes that carried over verbatim into chaos/link.py:
+
+- The chaos sits at the CHANNEL MESSAGE boundary, not the byte/packet
+  level: under SecretConnection a byte-level drop desyncs the AEAD stream
+  and under MConnection a packet drop corrupts reassembly — both turn
+  "loss" into instant connection death, which tests reconnect but not
+  protocol liveness under loss.
+- A dropped send REPORTS FAILURE (returns False) instead of silently
+  swallowing the message: tendermint gossip runs over TCP, so peer-state
+  bookkeeping assumes sent == will-be-delivered unless the connection
+  dies.  A silent drop plants a phantom "peer has this part/vote" bit;
+  block-part bitmaps deliberately have no repair channel, so one phantom
+  part can wedge a catching-up peer forever.
+- Inbound drops don't exist: discarding a message the remote has already
+  accounted as delivered fabricates the same phantom-delivery state — all
+  loss is injected on the send side, where it is honestly reportable.
 """
 
 from __future__ import annotations
 
-import asyncio
-import random
 from typing import Optional
 
-from ..libs.log import get_logger
+from ..chaos.link import LinkPolicy, LinkPolicyTable, PeerLink  # noqa: F401
 
 
 class PeerFuzz:
-    """Per-peer message-level chaos: installed by the switch when
-    p2p.test_fuzz is on.  Wraps peer.send and filters inbound messages."""
+    """Legacy constructor shape (prob_drop_rw / max_delay / seed) kept for
+    any external callers; internally one LinkPolicyTable with a wildcard
+    policy.  `install(peer)` returns the PeerLink carrying the familiar
+    dropped_sends / dropped_recvs counters."""
 
     def __init__(self, prob_drop_rw: float = 0.02, max_delay: float = 0.01,
                  seed: Optional[int] = None):
         self.prob_drop_rw = prob_drop_rw
         self.max_delay = max_delay
-        self.rng = random.Random(seed)
-        self.dropped_sends = 0
-        self.dropped_recvs = 0
-        self.log = get_logger("fuzz")
+        self.table = LinkPolicyTable(seed=seed)
+        self.table.set_policy(
+            LinkPolicyTable.WILDCARD,
+            LinkPolicy(drop=prob_drop_rw, jitter=max_delay),
+        )
 
-    async def _maybe_delay(self) -> None:
-        if self.max_delay > 0:
-            await asyncio.sleep(self.rng.random() * self.max_delay)
+    def install(self, peer) -> PeerLink:
+        return self.table.install(peer)
 
-    def install(self, peer) -> "PeerFuzz":
-        orig_send = peer.send
 
-        async def fuzzed_send(chan_id: int, msg: bytes) -> bool:
-            await self._maybe_delay()
-            if self.rng.random() < self.prob_drop_rw:
-                self.dropped_sends += 1
-                return False  # refused: sender knows it was not delivered
-            return await orig_send(chan_id, msg)
-
-        peer.send = fuzzed_send
-        peer.fuzz = self
-        return self
-
-    def drop_recv(self) -> bool:
-        """Inbound drops are disabled: discarding a message the remote has
-        already accounted as delivered fabricates the phantom-delivery
-        state TCP can never produce (see module docstring) — all loss is
-        injected on the send side, where it is honestly reportable."""
-        return False
+def table_from_fuzz_config(fuzz_config: dict, metrics=None, recorder=None) -> LinkPolicyTable:
+    """The node/switch mapping for `[p2p] test_fuzz` configs."""
+    table = LinkPolicyTable(
+        seed=fuzz_config.get("seed"), metrics=metrics, recorder=recorder
+    )
+    table.set_policy(
+        LinkPolicyTable.WILDCARD,
+        LinkPolicy(
+            drop=float(fuzz_config.get("prob_drop_rw", 0.02)),
+            jitter=float(fuzz_config.get("max_delay", 0.01)),
+        ),
+    )
+    return table

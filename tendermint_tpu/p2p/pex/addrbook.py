@@ -24,6 +24,7 @@ from typing import Dict, List, Optional
 
 from ...libs.log import get_logger
 from ..transport import parse_peer_addr
+from ..trust import TrustMetricStore
 
 NEW_BUCKET_COUNT = 256
 OLD_BUCKET_COUNT = 64
@@ -59,6 +60,9 @@ class KnownAddress:
     last_success: float = 0.0
     bucket_type: str = "new"
     buckets: List[int] = field(default_factory=list)
+    # persisted snapshot of the time-decaying trust score (p2p/trust.py);
+    # the live value lives in the book's TrustMetricStore
+    trust: float = 1.0
 
     @property
     def peer_id(self) -> str:
@@ -85,6 +89,7 @@ class KnownAddress:
             "last_success": self.last_success,
             "bucket_type": self.bucket_type,
             "buckets": list(self.buckets),
+            "trust": self.trust,
         }
 
     @classmethod
@@ -97,6 +102,7 @@ class KnownAddress:
             last_success=float(d.get("last_success", 0.0)),
             bucket_type=d.get("bucket_type", "new"),
             buckets=[int(b) for b in d.get("buckets", [])],
+            trust=float(d.get("trust", 1.0)),
         )
 
 
@@ -121,6 +127,10 @@ class AddrBook:
         self.old_buckets: List[Dict[str, KnownAddress]] = [dict() for _ in range(OLD_BUCKET_COUNT)]
         self.log = get_logger("addrbook")
         self._key = os.urandom(8).hex()  # per-book bucket-hash salt
+        # time-decaying conduct scores (p2p/trust.py), fed by the switch
+        # (dial failures, error stops) and behaviour reports; consulted by
+        # pick_address and eviction
+        self.trust = TrustMetricStore()
         if file_path and os.path.exists(file_path):
             self.load()
 
@@ -168,7 +178,14 @@ class AddrBook:
         if not bucket:
             return
         worst_id = max(
-            bucket, key=lambda p: (bucket[p].is_bad(), bucket[p].attempts, -bucket[p].last_success)
+            bucket,
+            key=lambda p: (
+                bucket[p].is_bad(),
+                # lowest trust evicts first (score decays on bad conduct)
+                round(1.0 - self.trust_value(p), 4),
+                bucket[p].attempts,
+                -bucket[p].last_success,
+            ),
         )
         ka = bucket.pop(worst_id)
         if idx in ka.buckets:
@@ -182,11 +199,28 @@ class AddrBook:
             ka.attempts += 1
             ka.last_attempt = time.time()
 
+    def mark_failed(self, addr_or_id: str) -> None:
+        """Bad-conduct trust event (failed dial, error stop, behaviour
+        report) WITHOUT removing the address — the score decay, not a
+        ban, is what demotes the peer in dial selection."""
+        pid = parse_peer_addr(addr_or_id)[0] if "@" in addr_or_id else addr_or_id
+        if pid:
+            self.trust.event(pid, good=False)
+            ka = self.addrs.get(pid)
+            if ka is not None:
+                ka.trust = self.trust.value(pid)
+
+    def trust_value(self, addr_or_id: str) -> float:
+        pid = parse_peer_addr(addr_or_id)[0] if "@" in addr_or_id else addr_or_id
+        return self.trust.value(pid)
+
     def mark_good(self, addr_or_id: str) -> None:
         """addrbook.go MarkGood: promote to an old bucket."""
         ka = self._lookup(addr_or_id)
         if ka is None:
             return
+        self.trust.event(ka.peer_id, good=True)
+        ka.trust = self.trust.value(ka.peer_id)
         ka.attempts = 0
         ka.last_success = time.time()
         ka.last_attempt = ka.last_success
@@ -244,17 +278,35 @@ class AddrBook:
 
     def pick_address(self, bias_towards_new: int = BIAS_TOWARDS_NEW) -> Optional[str]:
         """addrbook.go PickAddress — random non-bad address, tier chosen by
-        bias (% chance of a new-bucket address)."""
+        bias (% chance of a new-bucket address).  Dial priority consults
+        the trust score: once any candidate is meaningfully trusted, peers
+        whose score has decayed below half the best score stop winning
+        selection (they stay in the book and recover as their history
+        fades — p2p/trust parity, the VERDICT-missing wiring)."""
         if self.is_empty():
             return None
         candidates_old = [ka for ka in self.addrs.values() if ka.is_old() and not ka.is_bad()]
         candidates_new = [ka for ka in self.addrs.values() if not ka.is_old() and not ka.is_bad()]
-        use_new = random.randrange(100) < bias_towards_new
-        pool = candidates_new if use_new else candidates_old
-        if not pool:
-            pool = candidates_old or candidates_new
-        if not pool:
+        if not candidates_old and not candidates_new:
             return None
+        # trust gate ACROSS tiers: a tier containing only degraded peers
+        # must not win just because the bias coin chose it
+        scores = {
+            ka.peer_id: self.trust.value(ka.peer_id)
+            for ka in candidates_old + candidates_new
+        }
+        best = max(scores.values())
+        trusted_old = [ka for ka in candidates_old if scores[ka.peer_id] >= 0.5 * best]
+        trusted_new = [ka for ka in candidates_new if scores[ka.peer_id] >= 0.5 * best]
+        use_new = random.randrange(100) < bias_towards_new
+        pool = (
+            (trusted_new if use_new else trusted_old)
+            or trusted_old
+            or trusted_new
+            # every candidate is degraded: dial SOMEONE rather than stall
+            or candidates_old
+            or candidates_new
+        )
         return random.choice(pool).addr
 
     def get_selection(self) -> List[str]:
@@ -279,6 +331,10 @@ class AddrBook:
         if not self.file_path:
             return
         os.makedirs(os.path.dirname(self.file_path) or ".", exist_ok=True)
+        for pid, ka in self.addrs.items():
+            # snapshot live scores so a restart remembers who was flaky
+            if pid in self.trust.metrics:
+                ka.trust = self.trust.value(pid)
         payload = {
             "key": self._key,
             "addrs": [ka.to_dict() for ka in self.addrs.values()],
@@ -307,6 +363,7 @@ class AddrBook:
             if not pid or pid in self.our_ids:
                 continue
             self.addrs[pid] = ka
+            self.trust.seed(pid, ka.trust)
             ka.buckets.clear()
             if ka.is_old():
                 idx = self._bucket_idx_old(ka)

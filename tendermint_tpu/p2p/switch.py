@@ -34,12 +34,19 @@ class Switch(Service):
         max_inbound: int = 40,
         max_outbound: int = 10,
         fuzz_config: Optional[dict] = None,
+        link_policies=None,  # chaos.link.LinkPolicyTable (runtime fault layer)
         unconditional_peer_ids: Optional[set] = None,
         allow_duplicate_ip: bool = True,  # node passes config (default false)
     ):
         super().__init__("p2p-switch")
         self.transport = transport
-        self.fuzz_config = fuzz_config  # p2p/fuzz.go: chaos wrapper, tests only
+        # chaos layer: an explicit LinkPolicyTable wins; a legacy
+        # [p2p] test_fuzz config maps to a wildcard-policy table
+        self.link_policies = link_policies
+        if self.link_policies is None and fuzz_config is not None:
+            from .fuzz import table_from_fuzz_config
+
+            self.link_policies = table_from_fuzz_config(fuzz_config)
         # switch.go:69 policies: unconditional peers bypass the caps;
         # dup-IP inbound is rejected unless allowed (transport.go:376)
         self.unconditional_peer_ids = unconditional_peer_ids or set()
@@ -151,6 +158,10 @@ class Switch(Service):
             conn, ni = await self.transport.dial(hostport, expected_id=pid)
         except Exception as e:
             self.log.info("dial failed", addr=addr, err=str(e))
+            if self.addr_book is not None and pid:
+                # trust feed: failed dials decay the peer's score, which
+                # dial-priority selection consults (p2p/trust parity)
+                self.addr_book.mark_failed(pid)
             if persistent and pid:
                 self._maybe_reconnect(pid)
             return None
@@ -208,10 +219,8 @@ class Switch(Service):
             socket_addr=addr,
             on_send_bytes=_count_send_bytes,
         )
-        if self.fuzz_config is not None:
-            from .fuzz import PeerFuzz
-
-            PeerFuzz(**self.fuzz_config).install(peer)
+        if self.link_policies is not None:
+            self.link_policies.install(peer)
         for reactor in self.reactors.values():
             await reactor.init_peer(peer)
         await peer.start()
@@ -251,6 +260,9 @@ class Switch(Service):
         if peer.id not in self.peers:
             return
         self.log.info("stopping peer for error", peer=peer.id[:12], err=reason)
+        if self.addr_book is not None:
+            # trust feed: a peer stopped for cause is bad conduct
+            self.addr_book.mark_failed(peer.id)
         if asyncio.current_task() in peer.mconn._tasks:
             if self._stopped:
                 # Switch teardown in progress: spawn() would refuse (its

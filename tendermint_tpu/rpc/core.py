@@ -70,6 +70,12 @@ class RPCCore:
         "unsafe_stop_cpu_profiler",
         "unsafe_write_heap_profile",
         "unsafe_dump_tasks",
+        # chaos control (additionally gated by [chaos] enabled): the
+        # process rig's handle on this node's fault layer
+        "unsafe_chaos_link",
+        "unsafe_chaos_heal",
+        "unsafe_chaos_clock_skew",
+        "unsafe_chaos_status",
     )
     UNSAFE = {
         "dial_peers",
@@ -78,6 +84,10 @@ class RPCCore:
         "unsafe_stop_cpu_profiler",
         "unsafe_write_heap_profile",
         "unsafe_dump_tasks",
+        "unsafe_chaos_link",
+        "unsafe_chaos_heal",
+        "unsafe_chaos_clock_skew",
+        "unsafe_chaos_status",
     }
 
     def __init__(self, node, unsafe: bool = False, timeout_broadcast_tx_commit: float = 10.0):
@@ -547,6 +557,79 @@ class RPCCore:
     async def unsafe_flush_mempool(self) -> dict:
         await self.node.mempool.flush()
         return {}
+
+    # -- chaos control (config-gated: [chaos] enabled AND rpc.unsafe) ------
+
+    def _require_chaos(self) -> None:
+        """The ONE config gate for every chaos route (on top of the
+        rpc.unsafe gate `call` already enforces) — kept in one place so a
+        future tightening cannot silently miss a route."""
+        if not getattr(self.node.config.chaos, "enabled", False):
+            raise RPCError(INTERNAL_ERROR, "chaos routes require [chaos] enabled")
+
+    def _chaos_table(self, required: bool = True):
+        self._require_chaos()
+        table = getattr(self.node.switch, "link_policies", None) if self.node.switch else None
+        if table is None and required:
+            raise RPCError(INTERNAL_ERROR, "no link-policy table (p2p disabled?)")
+        return table
+
+    async def unsafe_chaos_link(
+        self,
+        peer_id: str = "*",
+        drop: float = 0.0,
+        delay: float = 0.0,
+        jitter: float = 0.0,
+        rate: float = 0.0,
+    ) -> dict:
+        """Set this node's OUTBOUND link policy toward `peer_id` ("*" =
+        every peer).  drop=1.0 partitions the link; all-zero heals it.
+        The scenario orchestrator (networks/local/chaos_smoke.py) stages
+        partitions by setting drop=1.0 symmetrically on both nodes."""
+        from ..chaos.link import degraded
+
+        table = self._chaos_table()
+        table.set_policy(peer_id, degraded(drop=drop, delay=delay, jitter=jitter, rate=rate))
+        return {"policies": table.policies()}
+
+    async def unsafe_chaos_heal(self) -> dict:
+        """Clear every link policy — the partition heals."""
+        table = self._chaos_table()
+        table.heal()
+        return {"policies": table.policies()}
+
+    async def unsafe_chaos_clock_skew(self, skew: float = 0.0) -> dict:
+        """Skew this node's consensus wall clock by `skew` seconds."""
+        self._require_chaos()
+        from ..chaos.clock import SkewedClock
+
+        clock = getattr(self.node, "chaos_clock", None)
+        if clock is None:
+            clock = SkewedClock(
+                skew,
+                metrics=getattr(self.node.metrics_provider, "chaos", None),
+                recorder=self.node.flight_recorder,
+            )
+            self.node.chaos_clock = clock
+            self.node.consensus.clock = clock
+        else:
+            clock.set_skew(skew)
+        return {"skew": clock.skew_s}
+
+    async def unsafe_chaos_status(self) -> dict:
+        """Active fault state: link policies, fault counters, clock skew,
+        twin equivocation count — the rig's view of what is injected."""
+        table = self._chaos_table(required=False)
+        clock = getattr(self.node, "chaos_clock", None)
+        pv = self.node.priv_validator
+        return {
+            "enabled": True,
+            "twin": bool(self.node.config.chaos.twin),
+            "equivocations": getattr(pv, "equivocations", 0),
+            "clock_skew_s": clock.skew_s if clock is not None else 0.0,
+            "policies": table.policies() if table is not None else {},
+            "counters": table.counters() if table is not None else {},
+        }
 
     # -- profiling/debug routes (routes.go:48-56; cProfile stands in for
     # pprof, an asyncio task dump for the goroutine dump) ------------------

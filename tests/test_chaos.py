@@ -1,0 +1,656 @@
+"""Chaos engine tests: fault primitives, scenario determinism, the
+invariant checker, and the two end-to-end rigs the ISSUE names —
+deterministic in-process partition→heal liveness, and twin double-sign →
+evidence committed → BeginBlock `byzantine_validators` (the full
+accountability pipeline driven by an actual byzantine node for the first
+time; previously only unit-tested piecewise)."""
+
+import asyncio
+import time
+
+import pytest
+
+from tendermint_tpu.chaos import (
+    InProcRig,
+    InvariantChecker,
+    LinkPolicy,
+    LinkPolicyTable,
+    RecoveryTimer,
+    Scenario,
+    ScenarioRunner,
+    SkewedClock,
+    TwinSigner,
+)
+from tendermint_tpu.chaos.checker import InvariantViolation, scan_committed_evidence
+from tendermint_tpu.chaos.link import PARTITIONED
+from tendermint_tpu.chaos.scenario import ScenarioError
+from tendermint_tpu.config import test_config as make_test_cfg
+from tendermint_tpu.node import Node
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
+from tendermint_tpu.types.params import BlockParams as _BP, ConsensusParams as _CP
+
+_FAST_IOTA_PARAMS = _CP(block=_BP(time_iota_ms=1))
+CHAIN_ID = "chaos-test-chain"
+
+
+# ---------------------------------------------------------------------------
+# fault primitives
+# ---------------------------------------------------------------------------
+
+
+class _FakePeer:
+    def __init__(self, pid="peer-a"):
+        self.node_info = type("NI", (), {"node_id": pid})()
+        self.id = pid
+        self.is_running = True
+        self.sent = []
+        self.tasks = []
+
+    async def send(self, chan_id, msg):
+        self.sent.append((chan_id, msg))
+        return True
+
+    def try_send(self, chan_id, msg):
+        self.sent.append((chan_id, msg))
+        return True
+
+    def spawn(self, coro, name=""):
+        # the Service surface the delayed-try_send path relies on: a
+        # TRACKED, strongly-referenced task (a real Peer cancels these
+        # on stop)
+        task = asyncio.get_event_loop().create_task(coro, name=name)
+        self.tasks.append(task)
+        return task
+
+
+class TestLinkPolicy:
+    async def test_partition_drops_and_heal_resumes(self):
+        table = LinkPolicyTable(seed=1)
+        peer = _FakePeer()
+        link = table.install(peer)
+        assert await peer.send(0x20, b"x")  # healthy link passes
+        table.set_policy(peer.id, PARTITIONED)
+        assert not await peer.send(0x20, b"y")  # refused, honestly reported
+        assert not peer.try_send(0x20, b"y2")
+        assert link.dropped_sends == 2
+        table.heal()
+        assert await peer.send(0x20, b"z")
+        assert [m for _, m in peer.sent] == [b"x", b"z"]
+
+    async def test_wildcard_policy_and_runtime_change(self):
+        table = LinkPolicyTable(seed=2)
+        peer = _FakePeer("peer-w")
+        table.install(peer)
+        table.set_policy("*", LinkPolicy(drop=1.0))
+        assert not await peer.send(1, b"a")
+        # per-peer policy overrides the wildcard at call time
+        table.set_policy(peer.id, LinkPolicy())  # healthy is a clear...
+        # healthy policies clear the entry, so the wildcard still applies
+        assert not await peer.send(1, b"b")
+        table.heal()
+        assert await peer.send(1, b"c")
+
+    async def test_seeded_drop_sequence_is_deterministic(self):
+        def run(seed):
+            table = LinkPolicyTable(seed=seed)
+            table.set_policy("*", LinkPolicy(drop=0.5))
+            return [table._pre_send(table.install(_FakePeer(f"p{i}")),
+                                    table.get("p"), 10) is None
+                    for i in range(32)]
+
+        assert run(7) == run(7)
+        assert run(7) != run(8)
+
+    async def test_delayed_try_send_delivers_later(self):
+        table = LinkPolicyTable(seed=3)
+        peer = _FakePeer("peer-d")
+        link = table.install(peer)
+        table.set_policy(peer.id, LinkPolicy(delay=0.02))
+        assert peer.try_send(5, b"delayed")  # accepted (deep queue model)
+        assert peer.sent == []  # not delivered yet
+        deadline = time.monotonic() + 5.0  # generous: suite load varies
+        while not peer.sent and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        assert peer.sent == [(5, b"delayed")]
+        assert link.delayed_sends == 1
+
+    async def test_throttle_injects_wait(self):
+        table = LinkPolicyTable(seed=4)
+        peer = _FakePeer("peer-t")
+        link = table.install(peer)
+        table.set_policy(peer.id, LinkPolicy(rate_bytes_per_sec=10_000))
+        t0 = time.monotonic()
+        for _ in range(3):  # 30 KiB through a 10 KiB/s link with 10 KiB burst
+            assert await peer.send(1, b"x" * 10_000)
+        assert time.monotonic() - t0 > 0.5
+        assert link.throttled_bytes > 0
+
+
+class TestSkewedClock:
+    def test_wall_skews_monotonic_does_not(self):
+        clk = SkewedClock(5.0)
+        assert abs(clk.time_ns() - time.time_ns() - 5_000_000_000) < 200_000_000
+        assert abs(clk.monotonic() - time.monotonic()) < 0.2
+        clk.set_skew(-2.0)
+        assert clk.time_ns() < time.time_ns()
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+
+class TestScenario:
+    TEXT = """
+    twin 0
+    partition 0,1|2,3 @3~0.5
+    heal @9~0.5          # comment survives
+    kill 2 @12; restart 2 @14
+    link 0->3 drop=0.3 delay=0.02 @16
+    skew 1 0.75 @18
+    """
+
+    def test_same_seed_same_timeline(self):
+        a, b = Scenario.parse(self.TEXT, seed=42), Scenario.parse(self.TEXT, seed=42)
+        assert a.fingerprint() == b.fingerprint()
+        assert [e.t for e in a.timeline()] == [e.t for e in b.timeline()]
+
+    def test_seed_changes_jittered_times_only(self):
+        a, b = Scenario.parse(self.TEXT, seed=1), Scenario.parse(self.TEXT, seed=2)
+        assert a.fingerprint() != b.fingerprint()
+        ta = {e.action: e.t for e in a.timeline()}
+        tb = {e.action: e.t for e in b.timeline()}
+        assert ta["kill"] == tb["kill"] == 12.0  # unjittered anchors fixed
+        assert ta["partition"] != tb["partition"]
+
+    def test_parse_rejects_garbage(self):
+        for bad in ("explode 3 @1", "partition 0,1 @2", "link 0-3 drop=1 @1",
+                    "link 0->3 frob=1 @1", "kill @2"):
+            with pytest.raises(ScenarioError):
+                Scenario.parse(bad)
+
+    def test_twin_marker_and_duration(self):
+        s = Scenario.parse(self.TEXT, seed=5)
+        assert s.twin_nodes() == [0]
+        assert s.duration() == 18.0
+
+    async def test_runner_executes_against_rig(self):
+        calls = []
+
+        class _Rig:
+            node_count = 4
+
+            async def set_link(self, a, b, pol):
+                calls.append(("link", a, b, pol.drop))
+
+            async def heal(self):
+                calls.append(("heal",))
+
+            async def kill(self, i):
+                calls.append(("kill", i))
+
+            async def restart(self, i):
+                calls.append(("restart", i))
+
+            async def set_skew(self, i, s):
+                calls.append(("skew", i, s))
+
+        s = Scenario.parse("partition 0|1 @0; heal @0.01; kill 1 @0.02; "
+                           "restart 1 @0.03; skew 0 1.5 @0.04", seed=0)
+        await ScenarioRunner(s, _Rig()).run()
+        assert ("link", 0, 1, 1.0) in calls and ("link", 1, 0, 1.0) in calls
+        assert calls[-3:] == [("kill", 1), ("restart", 1), ("skew", 0, 1.5)]
+
+
+# ---------------------------------------------------------------------------
+# checker
+# ---------------------------------------------------------------------------
+
+
+class TestInvariantChecker:
+    def test_agreement_violation_detected(self):
+        c = InvariantChecker(3)
+        c.observe_block_hash(0, 5, b"\xaa" * 32)
+        c.observe_block_hash(1, 5, b"\xaa" * 32)
+        assert c.ok() and c.agreed_heights() == [5]
+        c.observe_block_hash(2, 5, b"\xbb" * 32)
+        assert not c.ok()
+        with pytest.raises(InvariantViolation):
+            c.raise_if_violated()
+
+    def test_height_regression_detected_and_restart_rearms(self):
+        c = InvariantChecker(2)
+        c.observe_height(0, 10)
+        c.observe_height(0, 9)
+        assert any("regression" in v for v in c.violations)
+        c2 = InvariantChecker(2)
+        c2.observe_height(1, 10)
+        c2.note_restart(1)
+        c2.observe_height(1, 0)  # memdb restart: legal after note_restart
+        assert c2.ok()
+
+    def test_unreachable_is_not_a_violation(self):
+        c = InvariantChecker(2)
+        c.observe_height(0, 5)
+        c.observe_height(0, None)
+        c.observe_height(0, -1)
+        c.observe_height(0, 6)
+        assert c.ok()
+
+    def test_recovery_timer(self):
+        now = [100.0]
+        rt = RecoveryTimer(now_fn=lambda: now[0])
+        rt.mark("heal", baseline_height=7)
+        rt.observe(7)  # not yet above baseline
+        now[0] = 101.5
+        rt.observe(8)
+        assert rt.recovery_ms == {"heal": pytest.approx(1500.0)}
+        assert rt.unrecovered() == []
+
+
+# ---------------------------------------------------------------------------
+# trust scoring (satellite: p2p/trust parity)
+# ---------------------------------------------------------------------------
+
+
+class TestTrust:
+    def test_flaky_peer_score_decays_and_recovers(self):
+        from tendermint_tpu.p2p.trust import TrustMetric
+
+        now = [0.0]
+        m = TrustMetric(interval_s=10.0, now_fn=lambda: now[0])
+        assert m.value() == 1.0  # peers start trusted
+        for _ in range(8):
+            m.bad()
+        assert m.value() < 0.7
+        now[0] = 15.0  # roll the bad interval into history
+        v_hist = m.value()
+        assert v_hist < 1.0
+        for _ in range(20):
+            m.good()
+        assert m.value() > v_hist  # good conduct recovers trust
+        # pure time decay: with fading history and no events, the bad
+        # interval's weight shrinks as good intervals accumulate
+        for i in range(2, 6):
+            now[0] = i * 10.0 + 5.0
+            m.good()
+        assert m.value() > 0.8
+
+    def test_idle_time_alone_recovers_trust(self):
+        """A degraded peer we then never hear from must drift back toward
+        trusted (idle intervals push neutral history) — otherwise one bad
+        spell would exclude an outbound-only peer from dial selection
+        forever and it could never earn its way back."""
+        from tendermint_tpu.p2p.trust import TrustMetric
+
+        now = [0.0]
+        m = TrustMetric(interval_s=10.0, now_fn=lambda: now[0])
+        for _ in range(8):
+            m.bad()
+        now[0] = 15.0
+        low = m.value()
+        assert low < 0.3
+        now[0] = 95.0  # eight further intervals of silence
+        assert m.value() > max(0.5, low)
+
+    def test_degraded_peer_stops_winning_dial_selection(self):
+        """The chaos flaky-link contract: after the switch reports enough
+        failures, pick_address stops returning the degraded peer."""
+        from tendermint_tpu.p2p.pex.addrbook import AddrBook
+
+        book = AddrBook(strict=False)
+        good_addr = "a" * 40 + "@127.0.0.1:1001"
+        flaky_addr = "b" * 40 + "@127.0.0.1:1002"
+        book.add_address(good_addr, src="c" * 40)
+        book.add_address(flaky_addr, src="c" * 40)
+        book.mark_good(good_addr)
+        for _ in range(12):  # the switch's dial-failure / error-stop feed
+            book.mark_failed(flaky_addr)
+        assert book.trust_value("b" * 40) < 0.5 * book.trust_value("a" * 40)
+        picks = {book.pick_address() for _ in range(50)}
+        assert flaky_addr not in picks
+        assert good_addr in picks
+
+    def test_trust_persists_through_addrbook_roundtrip(self, tmp_path):
+        from tendermint_tpu.p2p.pex.addrbook import AddrBook
+
+        path = str(tmp_path / "book.json")
+        book = AddrBook(path, strict=False)
+        pid = "d" * 40
+        book.add_address(pid + "@127.0.0.1:2001", src="e" * 40)
+        for _ in range(12):
+            book.mark_failed(pid)
+        decayed = book.trust_value(pid)
+        assert decayed < 0.9
+        book.save()
+        book2 = AddrBook(path, strict=False)
+        assert book2.trust_value(pid) == pytest.approx(decayed, abs=0.15)
+
+
+# ---------------------------------------------------------------------------
+# evidence reactor sent-set bound (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestEvidenceSentBound:
+    async def test_sent_set_drops_committed_hashes(self):
+        from tendermint_tpu.evidence import EvidencePool
+        from tendermint_tpu.evidence_reactor import EvidenceReactor
+        from tendermint_tpu.libs.kvstore import open_db
+        from tendermint_tpu.state.store import StateStore
+        from tendermint_tpu.types import BlockID, PartSetHeader, Vote
+        from tendermint_tpu.types.canonical import PREVOTE_TYPE
+        from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+        pv = MockPV()
+
+        def _ev(n):
+            def _vote(blk):
+                v = Vote(type=PREVOTE_TYPE, height=2, round=n,
+                         block_id=BlockID(blk, PartSetHeader(1, b"\x02" * 32)),
+                         timestamp_ns=1, validator_address=pv.address(),
+                         validator_index=0)
+                pv.sign_vote(CHAIN_ID, v)
+                return v
+
+            return DuplicateVoteEvidence.from_votes(
+                pv.get_pub_key(), _vote(bytes([n]) * 32), _vote(bytes([n + 100]) * 32)
+            )
+
+        pool = EvidencePool(open_db("ev", None, "memdb"),
+                            StateStore(open_db("st", None, "memdb")))
+        pending = [_ev(1), _ev(2)]
+        pool.pending_evidence = lambda max_num=-1: list(pending)
+
+        sent_batches = []
+
+        class _PS:
+            height = 10
+
+        class _Peer:
+            id = "peer-bound"
+
+            def get(self, key):
+                return _PS() if key == "cs_peer_state" else None
+
+            async def send(self, chan, msg):
+                from tendermint_tpu.encoding import codec
+
+                sent_batches.append(codec.loads(msg)["evidence"])
+                return True
+
+        reactor = EvidenceReactor(pool)
+        await reactor.start()
+        try:
+            peer = _Peer()
+            await reactor.add_peer(peer)
+            await asyncio.sleep(0.2)
+            assert len(sent_batches) == 1 and len(sent_batches[0]) == 2
+            # both committed: they leave pending; the routine's next scan
+            # must intersect them OUT of its sent set (bounded memory)
+            pending.clear()
+            reactor._peer_events[peer.id].set()
+            await asyncio.sleep(0.2)
+            # re-add one of them as pending again (e.g. a fork re-orgs it
+            # back): it must be RE-SENT, proving the hash left `sent`
+            pending.append(_ev(1))
+            reactor._peer_events[peer.id].set()
+            await asyncio.sleep(0.2)
+            assert len(sent_batches) == 2
+            assert sent_batches[1][0].hash() == _ev(1).hash()
+        finally:
+            await reactor.stop()
+
+
+class TestEvidenceObservability:
+    def test_pool_metrics_and_spans(self):
+        """Satellite: the pool's pending/committed series and its
+        add/commit recorder spans actually move (it was invisible)."""
+        from prometheus_client import CollectorRegistry
+
+        from tendermint_tpu.evidence import EvidencePool
+        from tendermint_tpu.libs.kvstore import open_db
+        from tendermint_tpu.libs.metrics import EvidenceMetrics
+        from tendermint_tpu.libs.tracing import FlightRecorder
+        from tendermint_tpu.state.store import StateStore
+        from tendermint_tpu.types import BlockID, PartSetHeader, Vote
+        from tendermint_tpu.types.canonical import PREVOTE_TYPE
+        from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+        pv = MockPV()
+
+        def _vote(blk):
+            v = Vote(type=PREVOTE_TYPE, height=4, round=0,
+                     block_id=BlockID(blk, PartSetHeader(1, b"\x02" * 32)),
+                     timestamp_ns=1, validator_address=pv.address(),
+                     validator_index=0)
+            pv.sign_vote(CHAIN_ID, v)
+            return v
+
+        ev = DuplicateVoteEvidence.from_votes(
+            pv.get_pub_key(), _vote(b"\x01" * 32), _vote(b"\x03" * 32)
+        )
+        registry = CollectorRegistry()
+        pool = EvidencePool(open_db("ev", None, "memdb"),
+                            StateStore(open_db("st", None, "memdb")))
+        pool.metrics = EvidenceMetrics(registry, CHAIN_ID)
+        pool.recorder = FlightRecorder(size=64)
+        pool.add_evidence(ev)  # state=None: structural path, no verify
+
+        def val(name):
+            return registry.get_sample_value(
+                name, {"chain_id": CHAIN_ID}
+            )
+
+        assert val("tendermint_evidence_pending") == 1
+        assert val("tendermint_evidence_committed_total") == 0
+        pool.mark_committed(ev)
+        assert val("tendermint_evidence_pending") == 0
+        assert val("tendermint_evidence_committed_total") == 1
+        pool.mark_committed(ev)  # idempotent: no double count
+        assert val("tendermint_evidence_committed_total") == 1
+        kinds = [e["kind"] for e in pool.recorder.events()]
+        assert kinds == ["evidence.add", "evidence.commit"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end in-process rigs
+# ---------------------------------------------------------------------------
+
+
+async def make_chaos_net(tmp_path, n, name="chaos", twin_idx=None):
+    """N-validator full-node mesh with the chaos fault layer armed."""
+    pvs = sorted([MockPV() for _ in range(n)], key=lambda pv: pv.address())
+    gen = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
+        consensus_params=_FAST_IOTA_PARAMS,
+    )
+    nodes = []
+    for i, pv in enumerate(pvs):
+        cfg = make_test_cfg(str(tmp_path / f"{name}{i}"))
+        cfg.rpc.laddr = ""
+        cfg.base.db_backend = "memdb"
+        cfg.p2p.laddr = "127.0.0.1:0"
+        cfg.consensus.skip_timeout_commit = False
+        cfg.consensus.timeout_commit = 0.1
+        cfg.chaos.enabled = True
+        cfg.chaos.seed = 1234
+        cfg.chaos.twin = twin_idx == i
+        nodes.append(Node(cfg, gen, priv_validator=pv, db_backend="memdb"))
+    for node in nodes:
+        await node.start()
+    for i in range(n):
+        for j in range(i + 1, n):
+            addr = f"{nodes[j].node_key.id}@{nodes[j].switch.transport.listen_addr}"
+            await nodes[i].switch.dial_peer(addr)
+    for _ in range(300):
+        if all(node.switch.num_peers() == n - 1 for node in nodes):
+            break
+        await asyncio.sleep(0.01)
+    return nodes, pvs
+
+
+async def stop_net(nodes):
+    for node in nodes:
+        if node.is_running:
+            await node.stop()
+
+
+async def wait_heights(nodes, h, timeout=30.0):
+    async def _wait():
+        while not all(n.block_store.height() >= h for n in nodes):
+            await asyncio.sleep(0.05)
+
+    await asyncio.wait_for(_wait(), timeout)
+
+
+class TestPartitionHealLiveness:
+    async def test_partition_stalls_then_heals_within_bound(self, tmp_path):
+        """The scripted partition→heal scenario on the in-process net:
+        during a {0,1}|{2,3} split neither side has +2/3 (20/40), so
+        commits MUST stop; after heal they must resume within the bound,
+        and every height must agree across all nodes throughout."""
+        nodes, _ = await make_chaos_net(tmp_path, 4)
+        checker = InvariantChecker(4)
+        rig = InProcRig(nodes)
+        try:
+            await wait_heights(nodes, 2)
+            runner = ScenarioRunner(Scenario.parse("partition 0,1|2,3 @0"), rig)
+            await runner.run()
+            # drain in-flight gossip, then the net must be wedged
+            await asyncio.sleep(1.0)
+            stall_h = max(n.block_store.height() for n in nodes)
+            await asyncio.sleep(1.5)
+            assert max(n.block_store.height() for n in nodes) <= stall_h + 1, (
+                "commits continued across a partition with no +2/3 side"
+            )
+            for i, n in enumerate(nodes):
+                checker.observe_node(i, n)
+
+            timer = RecoveryTimer()
+            baseline = min(n.block_store.height() for n in nodes)
+            timer.mark("heal", baseline)
+            await rig.heal()
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                h = min(n.block_store.height() for n in nodes)
+                timer.observe(h)
+                if "heal" in timer.recovery_ms and h >= baseline + 2:
+                    break
+                await asyncio.sleep(0.1)
+            assert "heal" in timer.recovery_ms, "net never recovered after heal"
+            assert timer.recovery_ms["heal"] < 20_000
+            for i, n in enumerate(nodes):
+                checker.observe_node(i, n)
+            checker.raise_if_violated()
+            assert len(checker.agreed_heights()) >= 2
+        finally:
+            await stop_net(nodes)
+
+
+class TestTwinAccountability:
+    async def test_twin_double_sign_reaches_byzantine_validators(self, tmp_path):
+        """Twin node 0 equivocates from genesis; some honest node must
+        detect the conflict, pool DuplicateVoteEvidence, gossip it, a
+        proposer must commit it into a block, and BeginBlock must deliver
+        it via byzantine_validators (proven through the kvstore app's
+        recorded `__byzantine__` key) — the full accountability pipeline,
+        driven end to end by an actual byzantine node."""
+        from tendermint_tpu.abci.types import RequestQuery
+
+        nodes, pvs = await make_chaos_net(tmp_path, 4, name="twin", twin_idx=0)
+        twin_addr = nodes[0].priv_validator.get_pub_key().address()
+        assert isinstance(nodes[0].priv_validator, TwinSigner)
+        checker = InvariantChecker(4, liveness_exempt=[0])
+        try:
+            committed = None
+            deadline = time.monotonic() + 90.0
+            while time.monotonic() < deadline and committed is None:
+                for n in nodes[1:]:
+                    found = scan_committed_evidence(n.block_store)
+                    if found:
+                        committed = (n, found)
+                        break
+                await asyncio.sleep(0.2)
+            assert committed is not None, "twin evidence never committed"
+            node, found = committed
+            h, ev = found[0]
+            assert ev.address() == twin_addr
+
+            # BeginBlock delivery: the kvstore app records the addresses
+            # it saw in byzantine_validators
+            async def app_recorded():
+                while True:
+                    for n in nodes[1:]:
+                        res = await n.proxy_app.query().query(
+                            RequestQuery(data=b"__byzantine__")
+                        )
+                        if res.value and twin_addr.hex().encode() in res.value:
+                            return
+                    await asyncio.sleep(0.2)
+
+            await asyncio.wait_for(app_recorded(), 30.0)
+
+            # consensus metrics observed the byzantine power at that height
+            # (gauge is per-block; just assert agreement + recorder span)
+            rec_kinds = {e["kind"] for e in node.flight_recorder.events()}
+            assert "evidence.add" in rec_kinds and "evidence.commit" in rec_kinds
+            assert nodes[0].flight_recorder is not None
+            twin_kinds = {e["kind"] for e in nodes[0].flight_recorder.events()}
+            assert "chaos.twin_vote" in twin_kinds
+
+            for i, n in enumerate(nodes):
+                checker.observe_node(i, n)
+            checker.raise_if_violated()
+        finally:
+            await stop_net(nodes)
+
+
+class TestChaosRPCRoutes:
+    async def test_routes_gated_and_functional(self, tmp_path):
+        from tendermint_tpu.rpc.core import RPCCore
+        from tendermint_tpu.rpc.jsonrpc import RPCError
+
+        pv = MockPV()
+        gen = GenesisDoc(
+            chain_id=CHAIN_ID,
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10)],
+            consensus_params=_FAST_IOTA_PARAMS,
+        )
+        cfg = make_test_cfg(str(tmp_path / "rpc"))
+        cfg.rpc.laddr = ""
+        cfg.base.db_backend = "memdb"
+        cfg.p2p.laddr = "127.0.0.1:0"
+        cfg.chaos.enabled = True
+        node = Node(cfg, gen, priv_validator=pv, db_backend="memdb")
+        await node.start()
+        try:
+            # unsafe gating: without rpc.unsafe the route does not exist
+            core_safe = RPCCore(node, unsafe=False)
+            with pytest.raises(RPCError):
+                await core_safe.call("unsafe_chaos_status")
+
+            core = RPCCore(node, unsafe=True)
+            status = await core.call("unsafe_chaos_status")
+            assert status["enabled"] and status["policies"] == {}
+            res = await core.call(
+                "unsafe_chaos_link", {"peer_id": "*", "drop": 1.0}
+            )
+            assert res["policies"]["*"]["drop"] == 1.0
+            res = await core.call("unsafe_chaos_heal")
+            assert res["policies"] == {}
+            res = await core.call("unsafe_chaos_clock_skew", {"skew": 2.5})
+            assert res["skew"] == 2.5
+            assert node.consensus.clock.time_ns() > time.time_ns() + 1_000_000_000
+            await core.call("unsafe_chaos_clock_skew", {"skew": 0.0})
+
+            # config gating: chaos disabled -> route refuses
+            node.config.chaos.enabled = False
+            with pytest.raises(RPCError):
+                await core.call("unsafe_chaos_status")
+            node.config.chaos.enabled = True
+        finally:
+            await node.stop()

@@ -213,6 +213,14 @@ class TestLiveScrape:
             assert f"tendermint_verify_flush_quantum_seconds{{{key}}}" in metrics
             assert metrics[f"tendermint_verify_backend_tier{{{key}}}"] in (1, 2, 3)
 
+            # evidence pool observability: the series exist on every node
+            # (the pool was invisible before) — a clean run exports 0
+            assert metrics[f"tendermint_evidence_pending{{{key}}}"] == 0
+            assert metrics[f"tendermint_evidence_committed_total{{{key}}}"] == 0
+            # chaos family registered (populated only under fault injection)
+            assert metrics[f"tendermint_chaos_links_degraded{{{key}}}"] == 0
+            assert f"tendermint_chaos_msgs_dropped_total{{{key}}}" in metrics
+
             # send-side byte accounting mirrors the receive side: gossip to
             # the peer must have produced nonzero send-bytes series
             sent = sum(
