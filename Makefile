@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench bench-smoke trace-smoke statesync-smoke chaos-smoke localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke trace-smoke statesync-smoke chaos-smoke scale-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -28,6 +28,9 @@ statesync-smoke: ## empty 4th node joins a 3-val localnet via snapshot restore (
 chaos-smoke:     ## scripted partition/kill/twin scenario on a 4-val localnet; fails on any invariant violation
 	$(PY) networks/local/chaos_smoke.py --json
 	rm -rf build-chaos
+
+scale-smoke:     ## 100-validator in-proc net (engine ON, relay gossip): >=10 consecutive commits + partition/heal invariants
+	$(PY) networks/local/scale_smoke.py --json
 
 localnet:        ## 4-validator net as OS processes (no docker)
 	$(PY) -m tendermint_tpu.cli testnet --validators 4 --output ./build

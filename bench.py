@@ -380,6 +380,29 @@ def bench_chaos_recovery():
         return json.loads(run.stdout.strip().splitlines()[-1])
 
 
+def bench_scale_100val():
+    """BASELINE config #2 measured LIVE for the first time: a 100-validator
+    in-process net (verify engine ON, chordal peer topology, relay gossip +
+    maj23 vote aggregation) committing >= 10 consecutive blocks
+    (networks/local/scale_smoke.py), plus a 50|50 partition/heal judged by
+    the chaos invariant checker.  Reports `e2e_commits_per_sec_100val` and
+    the gossip wakeup/batch telemetry from the flight recorders.  Raises
+    if the net failed to commit, any invariant was violated, or the heal
+    never recovered."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    run = subprocess.run(
+        [sys.executable, os.path.join(repo, "networks", "local", "scale_smoke.py"),
+         "--json"],
+        capture_output=True, text=True, timeout=3600, cwd=repo,
+    )
+    if run.returncode != 0:
+        raise RuntimeError(f"scale smoke failed:\n{run.stdout[-2000:]}\n{run.stderr[-2000:]}")
+    return json.loads(run.stdout.strip().splitlines()[-1])
+
+
 def bench_statesync_bootstrap():
     """Statesync bootstrap time, measured from REAL recorder spans: an
     empty 4th node joins a live 3-validator localnet via snapshot restore
@@ -648,6 +671,10 @@ def main() -> None:
         chaos = bench_chaos_recovery()
     except Exception as e:
         chaos = {"chaos_partition_recovery_ms": -1.0, "error": str(e)[:300]}
+    try:
+        scale = bench_scale_100val()
+    except Exception as e:
+        scale = {"e2e_commits_per_sec_100val": -1.0, "error": str(e)[:300]}
     extras = {
         "commit_verify_100val_ms": bench_100val_commit(),
         "e2e_commits_per_sec_solo": asyncio.run(bench_e2e_commits()),
@@ -683,6 +710,14 @@ def main() -> None:
         "chaos_partition_recovery_ms": chaos.get("chaos_partition_recovery_ms", -1.0),
         "chaos_restart_recovery_ms": chaos.get("restart_recovery_ms"),
         "chaos_evidence_height": chaos.get("evidence_height"),
+        "e2e_commits_per_sec_100val": scale.get("e2e_commits_per_sec_100val", -1.0),
+        "scale_100val_block_ms": scale.get("block_ms"),
+        "scale_100val_startup_s": scale.get("startup_s"),
+        "scale_100val_engine_device_path": scale.get("engine_device_path"),
+        "scale_100val_gossip": scale.get("gossip"),
+        "chaos_partition_recovery_ms_100val": scale.get(
+            "chaos_partition_recovery_ms_100val"
+        ),
         "vote_hop_flush_ms": round(hop_ms, 3),
         "e2e_4val_recorder": procs.get("recorder"),
         "e2e_4val_breakdown": _e2e_breakdown(procs, hop_ms),

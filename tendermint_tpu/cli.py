@@ -103,6 +103,22 @@ def cmd_run(args) -> int:
     return 0
 
 
+def _testnet_peer_indices(i: int, n: int):
+    """Persistent-peer topology for an n-node testnet.  Small nets keep
+    the reference's full mesh; past 16 nodes a chordal ring (offsets
+    1, 2, 4, ... mod n) bounds per-node connections at O(log n) while
+    keeping diameter O(log n) — the relay gossip topology and the PEX
+    discovery layer carry the rest.  Peer-set sizing is what lets a
+    100-node localnet start without 4950 TCP connections."""
+    if n <= 16:
+        return [j for j in range(n) if j != i]
+    offsets, k = [], 1
+    while k < n:
+        offsets.append(k)
+        k *= 2
+    return sorted({(i + off) % n for off in offsets} - {i})
+
+
 def cmd_testnet(args) -> int:
     """commands/testnet.go — an N-validator config tree under --output;
     every node lists every other as a persistent peer (the docker-compose
@@ -166,9 +182,17 @@ def cmd_testnet(args) -> int:
             cfg.p2p.laddr = f"tcp://127.0.0.1:{base_port + 10 * i}"
             cfg.rpc.laddr = f"tcp://127.0.0.1:{base_port + 10 * i + 1}"
             cfg.p2p.persistent_peers = ",".join(
-                f"{node_keys[j].id}@127.0.0.1:{base_port + 10 * j}" for j in range(n) if j != i
+                f"{node_keys[j].id}@127.0.0.1:{base_port + 10 * j}"
+                for j in _testnet_peer_indices(i, n)
             )
         cfg.p2p.allow_duplicate_ip = True
+        # peer-set sizing: a big testnet must not trip the reference's
+        # 40-inbound default (full mesh at small n; chordal degree at
+        # large n still means ~2·log2(n) connections per node both ways)
+        cfg.p2p.max_num_inbound_peers = max(cfg.p2p.max_num_inbound_peers, n + 8)
+        cfg.p2p.max_num_outbound_peers = max(
+            cfg.p2p.max_num_outbound_peers, len(_testnet_peer_indices(i, n))
+        )
         if fast:
             cfg.base.fast_sync = False
             cfg.base.db_backend = args.db_backend or "memdb"

@@ -27,24 +27,43 @@ fixed sleep survives only as a fallback cap, so the tick can be raised
 without adding latency.  The gossip paper contract (arXiv:1807.04938:
 eventual delivery) is unchanged; only the pacing is.
 
-Wire compatibility: `vote_batch` is negotiated via NodeInfo.gossip_version
-(p2p/node_info.py) — peers that never advertised it (older nodes, or
-`consensus.gossip_vote_batch = false`) receive the reference's single-vote
-messages, so mixed-version nets still converge.
+TPU inversion #3 (committee scale): full-mesh vote gossip is O(N²) frames
+per round — at 100 validators every vote crosses every link and every
+vote added triggers a has_vote broadcast to every peer, which is exactly
+the fan-out wall arXiv:2302.00418 measures for committee consensus.  With
+`consensus.gossip_relay_degree` (and enough peers), event-driven vote
+pushes go to a deterministic O(d) relay subset per (height, round) —
+edges are scored by hashing the undirected (height, round, id-pair), so
+the subset rotates every round, both ends rank their shared edge
+identically, and the union of 100 nodes' relay choices forms an expander
+whp.  The repair tick (the fallback cap) still scans EVERY peer, so
+completeness is a pacing property, not a topology property.  On top of
+that rides maj23-driven aggregation: once this node holds +2/3 for a
+step, capable peers get a compact `vote_summary` (have-maj23 + our vote
+bitmap) instead of a vote stream; a receiver diffs the bitmap against
+its own set and answers `vote_pull` with exactly the bits it lacks, and
+the pulled `vote_batch` lands in the engine as ONE verify_many flush.
+
+Wire compatibility: `vote_batch` (and the summary exchange) is negotiated
+via NodeInfo.gossip_version (p2p/node_info.py) — peers that never
+advertised it (older nodes, or `consensus.gossip_vote_batch = false`)
+receive the reference's single-vote messages, peers at version 1 get
+batches but no summaries, so mixed-version nets still converge.
 """
 
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import random
 import time
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Dict, List, Optional, Set, Tuple, Union
 
 from ..encoding import codec
 from ..libs.bitarray import BitArray
 from ..libs.log import get_logger
 from ..p2p import ChannelDescriptor, Reactor
-from ..p2p.node_info import GOSSIP_BATCH_VERSION
+from ..p2p.node_info import GOSSIP_BATCH_VERSION, GOSSIP_SUMMARY_VERSION
 from ..types import BlockID, Proposal, Vote
 from ..types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
 from ..types.part_set import Part
@@ -60,10 +79,30 @@ VOTE_SET_BITS_CHANNEL = 0x23
 # decode stops a peer exceeding it before any per-vote work happens.
 MAX_VOTE_BATCH_ENTRIES = 16384
 
+# Received batches at least this big skip the AsyncBatchVerifier's
+# coalescing flusher and go to the engine as one direct call — they are
+# already batch-shaped, and the flusher's scheduling hops dominate at
+# committee scale (smaller trickles still coalesce across peers).
+DIRECT_VERIFY_MIN = 16
+
 
 class PeerRoundState:
     """What we know about a peer's consensus position
-    (consensus/types/peer_round_state.go + reactor.go:915 PeerState)."""
+    (consensus/types/peer_round_state.go + reactor.go:915 PeerState).
+
+    Per-peer state is BOUNDED for committee scale: every container here
+    that is keyed by a peer-suppliable round (the vote bit tables) or by
+    (height, round, type) tuples (the dedupe maps) is capped — at N=100
+    validators × 100 peers an unbounded O(rounds) table per peer is an
+    O(N × rounds) allocation a stuck height grows forever, and a hostile
+    peer can mint arbitrary round numbers in has_vote messages."""
+
+    # Vote bit tables keep only the highest MAX_TRACKED_ROUNDS rounds per
+    # type; dedupe maps (maj23_sent / summary_sent) prune expired entries
+    # past MAX_SENT_ENTRIES.  Both are repair-safe: evicting an entry only
+    # means one redundant re-send, never a lost vote.
+    MAX_TRACKED_ROUNDS = 64
+    MAX_SENT_ENTRIES = 256
 
     def __init__(self):
         self.height = 0
@@ -89,6 +128,10 @@ class PeerRoundState:
         # re-sending identical claims every tick; entries expire so the
         # VoteSetBits repair exchange can still re-fire for a stuck peer.
         self.maj23_sent: Dict[tuple, float] = {}
+        # vote_summary dedupe: (height, round, type) -> (bit count at last
+        # send, monotonic send time).  Re-sent when our set grew (laggards
+        # can pull the new votes) or after expiry (lost-frame repair).
+        self.summary_sent: Dict[tuple, Tuple[int, float]] = {}
 
     # -- updates from peer messages ---------------------------------------
     def apply_new_round_step(self, msg: dict) -> None:
@@ -114,6 +157,7 @@ class PeerRoundState:
             self.prevotes = {}
             self.precommits = {}
             self.maj23_sent.clear()
+            self.summary_sent.clear()
 
     def apply_new_valid_block(self, msg: dict) -> None:
         if self.height != msg["height"]:
@@ -155,6 +199,16 @@ class PeerRoundState:
             table = self.prevotes if vote_type == PREVOTE_TYPE else self.precommits
             if round_ not in table:
                 table[round_] = BitArray(num_validators)
+                # bound: rounds are peer-suppliable (has_vote / summary
+                # messages carry arbitrary ints) — keep the newest only.
+                # If the round we just inserted IS the oldest, it is
+                # refused tracking (None, same as an unresolvable claim)
+                # rather than evicting a newer live round.
+                while len(table) > self.MAX_TRACKED_ROUNDS:
+                    victim = min(table)
+                    del table[victim]
+                    if victim == round_:
+                        return None
             return table[round_]
         if height == self.height - 1 and vote_type == PRECOMMIT_TYPE and round_ == self.last_commit_round:
             if self.last_commit is None:
@@ -162,12 +216,24 @@ class PeerRoundState:
             return self.last_commit
         return None
 
+    def prune_sent(self, table: Dict[tuple, object], now: float, expired_before: float) -> None:
+        """Cap a (maj23/summary) dedupe map: drop expired entries once the
+        map exceeds MAX_SENT_ENTRIES, then oldest-first if still over."""
+        if len(table) <= self.MAX_SENT_ENTRIES:
+            return
+        for k in [k for k, v in table.items() if _sent_time(v) < expired_before]:
+            del table[k]
+        while len(table) > self.MAX_SENT_ENTRIES:
+            del table[min(table, key=lambda k: _sent_time(table[k]))]
+
     def set_has_vote(self, height: int, round_: int, vote_type: int, index: int, num_validators: int = 0) -> None:
         bits = self.get_vote_bits(height, round_, vote_type, num_validators)
         if bits is not None and index < bits.bits:
             bits.set_index(index, True)
 
-    def apply_vote_set_bits(self, msg: dict, our_votes: Optional[BitArray]) -> None:
+    def apply_vote_set_bits(
+        self, msg: dict, our_votes: Optional[BitArray], num_validators: int = -1
+    ) -> None:
         """reactor.go ApplyVoteSetBitsMessage: the peer's response is the
         TRUTH for the claimed vote set — replace that slice of our belief,
         `(existing − ourVotes) ∪ theirBits`, keeping only the bits outside
@@ -175,9 +241,22 @@ class PeerRoundState:
         delivered that the peer never received (send raced a disconnect,
         message lost in a lossy link) is otherwise never re-gossiped, and
         a node missing one prevote wedges at step PREVOTE with no timeout
-        pending — the maj23/VoteSetBits exchange is the designed repair."""
+        pending — the maj23/VoteSetBits exchange is the designed repair.
+
+        `num_validators` (our validator-set size for the claimed height)
+        clamps the allocation: the wire bitmap's length header is
+        attacker-suppliable, and sizing a fresh per-round BitArray from it
+        let one frame allocate gigabytes.  0 = the height doesn't resolve
+        to a set we hold — skip entirely (like the vote_batch/summary
+        receive paths) rather than create a permanent zero-size entry:
+        get_vote_bits sizes only on creation, and a 0-bit belief array
+        makes set_has_vote a no-op, so every later send pass would see
+        every vote missing and resend the full batch forever."""
+        if num_validators == 0:
+            return
         bits = BitArray.from_bytes(msg["votes"])
-        existing = self.get_vote_bits(msg["height"], msg["round"], msg["type"], bits.bits)
+        size = bits.bits if num_validators < 0 else min(bits.bits, num_validators)
+        existing = self.get_vote_bits(msg["height"], msg["round"], msg["type"], size)
         if existing is None:
             return
         n = min(existing.bits, bits.bits)
@@ -197,6 +276,11 @@ class ConsensusReactor(Reactor):
         self.log = get_logger("cs-reactor")
         self.peer_states: Dict[str, PeerRoundState] = {}
         self._routines: Dict[str, list] = {}
+        # relay topology: memoized target set for the current
+        # (height, round, peer-set generation) — recomputed lazily, so a
+        # burst of vote events at N=100 pays one hash ranking, not N
+        self._relay_cache: Optional[Tuple[tuple, Optional[Set[str]]]] = None
+        self._peer_gen = 0  # bumped on peer add/remove; invalidates cache
         cs.on_new_round_step.append(self._on_new_round_step)
         cs.on_vote.append(self._on_vote_event)
         cs.on_valid_block.append(self._on_valid_block)
@@ -253,13 +337,36 @@ class ConsensusReactor(Reactor):
     def _on_vote_event(self, vote: Vote) -> None:
         """broadcastHasVoteMessage (reactor.go:422) — fires for every vote
         added to our sets (own or relayed), which is exactly when a peer
-        might newly lack one: wake the vote gossip routines."""
-        msg = _enc("has_vote", {
-            "height": vote.height, "round": vote.round,
-            "vote_type": vote.type, "index": vote.validator_index,
-        })
-        self.spawn(self._broadcast(STATE_CHANNEL, msg), "bcast-hasvote")
-        self._wake_peers(votes=True)
+        might newly lack one: wake the vote gossip routines.
+
+        With the relay topology active, the per-vote has_vote frame is
+        suppressed entirely and only the O(d) relay subset is woken —
+        per-vote full-mesh chatter is the O(N²·V) term that wedges
+        100-validator nets.  The announcement is ~redundant there: our own
+        batched push marks possession on both ends (`set_has_vote` on
+        send, `_mark_peer_vote` on receive), and everyone else learns
+        what we hold from summaries, the VoteSetBits exchange, and the
+        repair tick.
+
+        Targets are keyed by OUR (height, round) — the same key
+        `_relay_ok` gates the woken routine's push with — not the vote's:
+        a late vote for an older round must wake peers whose pushes will
+        actually be allowed, and a single shared key keeps the memoized
+        ranking hot (alternating keys would recompute N edge hashes per
+        event)."""
+        targets = self._relay_targets(self.cs.rs.height, self.cs.rs.round)
+        if targets is None:
+            msg = _enc("has_vote", {
+                "height": vote.height, "round": vote.round,
+                "vote_type": vote.type, "index": vote.validator_index,
+            })
+            self.spawn(self._broadcast(STATE_CHANNEL, msg), "bcast-hasvote")
+            self._wake_peers(votes=True)
+            return
+        for pid in targets:
+            ps = self.peer_states.get(pid)
+            if ps is not None:
+                ps.vote_wake.set()
 
     def _on_valid_block(self, rs) -> None:
         self._wake_peers(data=True)
@@ -300,6 +407,7 @@ class ConsensusReactor(Reactor):
     async def add_peer(self, peer) -> None:
         ps = PeerRoundState()
         self.peer_states[peer.id] = ps
+        self._peer_gen += 1
         peer.set("cs_peer_state", ps)
         await peer.send(STATE_CHANNEL, self._new_round_step_msg())
         if not self.wait_sync:
@@ -314,6 +422,7 @@ class ConsensusReactor(Reactor):
 
     async def remove_peer(self, peer, reason=None) -> None:
         self.peer_states.pop(peer.id, None)
+        self._peer_gen += 1
         for task in self._routines.pop(peer.id, []):
             task.cancel()
 
@@ -324,6 +433,49 @@ class ConsensusReactor(Reactor):
             self.cs.config.gossip_vote_batch
             and getattr(peer, "gossip_version", 0) >= GOSSIP_BATCH_VERSION
         )
+
+    def _peer_summarized(self, peer) -> bool:
+        """True when the maj23 summary/pull exchange may be used with this
+        peer (negotiated like vote_batch, one capability level up)."""
+        return (
+            self.cs.config.gossip_vote_batch
+            and self.cs.config.gossip_vote_summary
+            and getattr(peer, "gossip_version", 0) >= GOSSIP_SUMMARY_VERSION
+        )
+
+    # -- relay topology ----------------------------------------------------
+    def _relay_targets(self, height: int, round_: int) -> Optional[Set[str]]:
+        """The deterministic O(d) relay subset of connected peers for
+        (height, round); None = full mesh (relay off, or too few peers for
+        the topology to pay).  Each undirected edge (us, peer) is scored by
+        hashing (height, round, sorted id pair) — both endpoints rank the
+        shared edge identically, the ranking is uncorrelated across rounds
+        (stuck rounds re-roll the graph), and the union of every node's d
+        cheapest edges forms a connected expander whp at committee sizes."""
+        cfg = self.cs.config
+        d = cfg.gossip_relay_degree
+        n = len(self.peer_states)
+        if d <= 0 or n <= max(d, cfg.gossip_relay_min_peers):
+            return None
+        key = (height, round_, self._peer_gen)
+        cached = self._relay_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        me = getattr(self.switch, "node_id", "") or ""
+        prefix = b"%d|%d|" % (height, round_)
+
+        def edge_score(pid: str) -> bytes:
+            a, b = (me, pid) if me < pid else (pid, me)
+            return hashlib.sha256(prefix + a.encode() + b"|" + b.encode()).digest()
+
+        targets = set(sorted(self.peer_states, key=edge_score)[:d])
+        self._relay_cache = (key, targets)
+        return targets
+
+    def _relay_ok(self, peer_id: str) -> bool:
+        """May event-triggered passes push votes to this peer right now?"""
+        targets = self._relay_targets(self.cs.rs.height, self.cs.rs.round)
+        return targets is None or peer_id in targets
 
     # -- receive demux (reactor.go:214) ------------------------------------
     async def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
@@ -353,6 +505,8 @@ class ConsensusReactor(Reactor):
                 )
             elif kind == "vote_set_maj23":
                 await self._handle_vote_set_maj23(peer, msg)
+            elif kind == "vote_summary":
+                await self._handle_vote_summary(peer, ps, msg)
         elif self.wait_sync:
             return  # ignore data/votes while fast-syncing (reactor.go:231)
         elif chan_id == DATA_CHANNEL:
@@ -410,11 +564,13 @@ class ConsensusReactor(Reactor):
                     )
                     if vs is not None:
                         our_votes = vs.bit_array_by_block_id(BlockID.from_dict(msg["block_id"]))
-                ps.apply_vote_set_bits(msg, our_votes)
+                ps.apply_vote_set_bits(msg, our_votes, self._num_validators(msg["height"]))
                 # bits may have been CLEARED (the repair path): the peer
                 # lacks votes we thought delivered — resend without waiting
                 # out a tick
                 ps.vote_wake.set()
+            elif kind == "vote_pull":
+                await self._handle_vote_pull(peer, ps, msg)
 
     def _mark_peer_vote(self, ps: PeerRoundState, vote: Vote) -> None:
         rs = self.cs.rs
@@ -472,6 +628,23 @@ class ConsensusReactor(Reactor):
             votes.append(vote)
         if not votes:
             return
+        # piggybacked possession bitmap: fold the sender's full bit array
+        # for the set into our belief (it covers votes it received from
+        # third parties — the anti-echo half of the relay topology)
+        have = msg.get("have")
+        if isinstance(have, bytes):
+            try:
+                height, round_, vtype = int(msg["h"]), int(msg["r"]), int(msg["t"])
+                theirs = BitArray.from_bytes(have)
+            except Exception:
+                await self.switch.stop_peer_for_error(peer, "malformed vote_batch have")
+                return
+            n_vals = self._num_validators(height)
+            if n_vals > 0:
+                bits = ps.get_vote_bits(height, round_, vtype, n_vals)
+                if bits is not None:
+                    k = min(bits.bits, theirs.bits)
+                    bits._v[:k] |= theirs._v[:k]
         for vote in votes:
             self._mark_peer_vote(ps, vote)
         keep: List[Tuple[Vote, object, bytes]] = []  # (vote, pub_key, sign_bytes)
@@ -508,12 +681,16 @@ class ConsensusReactor(Reactor):
                 # own key type, same as the single-vote path
                 results[i] = bool(pub_key.verify(sign_bytes, vote.signature))
         if engine:
+            entries = [(pk, sb, sig) for _, pk, sb, sig in engine]
             try:
-                res = await asyncio.gather(
-                    *self.async_verifier.verify_many(
-                        [(pk, sb, sig) for _, pk, sb, sig in engine]
+                if len(entries) >= DIRECT_VERIFY_MIN:
+                    # already batch-shaped: one direct engine call, no
+                    # coalescing-flusher scheduling hops (committee scale)
+                    res = await self.async_verifier.verify_direct(entries)
+                else:
+                    res = await asyncio.gather(
+                        *self.async_verifier.verify_many(entries)
                     )
-                )
             except Exception:
                 return
             for (i, _, _, _), ok in zip(engine, res):
@@ -550,6 +727,154 @@ class ConsensusReactor(Reactor):
                 "block_id": msg["block_id"], "votes": our.to_bytes(),
             }),
         )
+
+    # -- maj23-driven vote aggregation (summary / pull) --------------------
+    def _num_validators(self, height: int) -> int:
+        """Our validator-set size for a claimed height; 0 when the height
+        does not pin to a set we hold (the claim is then unusable anyway).
+        Used to clamp every peer-supplied bitmap allocation."""
+        rs = self.cs.rs
+        if height == rs.height and rs.validators is not None:
+            return rs.validators.size()
+        if height == rs.height - 1 and rs.last_validators is not None:
+            return rs.last_validators.size()
+        if height == rs.height + 1 and rs.validators is not None:
+            # a peer one height ahead summarizes against a set we may not
+            # hold yet; our current set is the best available clamp
+            return rs.validators.size()
+        return 0
+
+    def _summary_vote_set(self, height: int, round_: int, vote_type: int):
+        """Resolve a (height, round, type) claim to a live VoteSet we can
+        serve pulls from / diff summaries against: the current height's
+        sets, or last_commit for height-1 precommits."""
+        rs = self.cs.rs
+        if height == rs.height and rs.votes is not None:
+            return (
+                rs.votes.prevotes(round_)
+                if vote_type == PREVOTE_TYPE
+                else rs.votes.precommits(round_)
+            )
+        if (
+            height == rs.height - 1
+            and rs.last_commit is not None
+            and vote_type == PRECOMMIT_TYPE
+            and round_ == rs.last_commit.round
+        ):
+            return rs.last_commit
+        return None
+
+    # bitmap-growth summary re-sends are rate-limited to one per this many
+    # seconds per (peer, height, round, type); expiry-driven repair
+    # re-sends are governed by the (longer) fallback cap
+    SUMMARY_REFRESH = 0.25
+
+    async def _maybe_send_summary(self, peer, ps: PeerRoundState, vote_set) -> bool:
+        """Send a compact have-maj23 + vote-bitmap summary instead of
+        streaming votes (the aggregation path, gossip_version >= 2).
+        Deduped per (height, round, type): re-sent only when our bitmap
+        grew (new votes for laggards to pull, refresh-floored) or after
+        expiry (frame loss repair)."""
+        bits = vote_set.bit_array()
+        count = bits.count()
+        key = (vote_set.height, vote_set.round, vote_set.signed_msg_type)
+        now = time.monotonic()
+        resend_after = max(
+            self._fallback_cap(self.cs.config.peer_gossip_sleep_duration), 1.0
+        )
+        prev = ps.summary_sent.get(key)
+        if prev is not None:
+            grown = count > prev[0]
+            age = now - prev[1]
+            # growth alone re-sends only past a refresh floor — without it
+            # every late vote re-summarizes to every peer (measured ~65
+            # summaries/node/block at N=20); expiry still repairs losses
+            if not (grown and age >= self.SUMMARY_REFRESH) and age < resend_after:
+                return False
+        maj23, _ = vote_set.two_thirds_majority()
+        if maj23 is None:
+            return False
+        ok = await peer.send(STATE_CHANNEL, _enc("vote_summary", {
+            "height": vote_set.height, "round": vote_set.round,
+            "type": vote_set.signed_msg_type, "block_id": maj23.to_dict(),
+            "votes": bits.to_bytes(),
+        }))
+        if ok:
+            ps.summary_sent[key] = (count, now)
+            ps.prune_sent(ps.summary_sent, now, now - resend_after)
+            self.cs.metrics.vote_summaries.inc()
+            self.cs.recorder.record(
+                "gossip.summary", n=count, peer=peer.id[:8],
+                h=vote_set.height, r=vote_set.round, t=vote_set.signed_msg_type,
+            )
+        return ok
+
+    async def _handle_vote_summary(self, peer, ps: PeerRoundState, msg: dict) -> None:
+        """Receive side of the aggregation path: the sender holds +2/3 and
+        these votes.  Fold its bitmap into our belief (so we never stream
+        those votes back), record the maj23 claim, and pull exactly the
+        votes we lack — the response is a vote_batch that lands in the
+        engine as one flush."""
+        try:
+            height, round_, vtype = int(msg["height"]), int(msg["round"]), int(msg["type"])
+            theirs = BitArray.from_bytes(msg["votes"])
+            block_id = BlockID.from_dict(msg["block_id"])
+        except Exception:
+            await self.switch.stop_peer_for_error(peer, "malformed vote_summary")
+            return
+        n_vals = self._num_validators(height)
+        if n_vals <= 0:
+            return  # height not resolvable against our sets; ignore
+        # belief update: the sender HAS these votes (superset claims are
+        # self-harm only — we'd skip sending votes the peer then pulls)
+        bits = ps.get_vote_bits(height, round_, vtype, n_vals)
+        if bits is not None:
+            n = min(bits.bits, theirs.bits)
+            bits._v[:n] |= theirs._v[:n]
+        rs = self.cs.rs
+        if height == rs.height and rs.votes is not None:
+            try:
+                rs.votes.set_peer_maj23(round_, vtype, peer.id, block_id)
+            except Exception as e:
+                await self.switch.stop_peer_for_error(peer, str(e))
+                return
+        vote_set = self._summary_vote_set(height, round_, vtype)
+        if vote_set is None:
+            return
+        want = vote_set.bits_we_lack(theirs)
+        if want.is_empty():
+            return
+        self.cs.recorder.record(
+            "gossip.pull_req", n=want.count(), peer=peer.id[:8], h=height, r=round_,
+        )
+        await peer.send(VOTE_SET_BITS_CHANNEL, _enc("vote_pull", {
+            "height": height, "round": round_, "type": vtype,
+            "want": want.to_bytes(),
+        }))
+
+    async def _handle_vote_pull(self, peer, ps: PeerRoundState, msg: dict) -> None:
+        """Serve a pull: exactly the requested canonical votes, as one
+        byte-capped vote_batch (the puller advertised >= batch capability
+        by speaking the summary exchange at all)."""
+        if not self._peer_batched(peer):
+            return
+        try:
+            height, round_, vtype = int(msg["height"]), int(msg["round"]), int(msg["type"])
+            want = BitArray.from_bytes(msg["want"])
+        except Exception:
+            await self.switch.stop_peer_for_error(peer, "malformed vote_pull")
+            return
+        vote_set = self._summary_vote_set(height, round_, vtype)
+        if vote_set is None:
+            return
+        votes = vote_set.select_votes(want)
+        if not votes:
+            return
+        self.cs.metrics.vote_pulls.inc()
+        self.cs.recorder.record(
+            "gossip.pull_serve", n=len(votes), peer=peer.id[:8], h=height, r=round_,
+        )
+        await self._send_vote_batch(peer, ps, votes, vote_set.size(), have=vote_set)
 
     # -- vote pre-verification (the TPU batch path) ------------------------
     def _resolve_vote(self, vote: Vote) -> Union[None, bool, Tuple[object, bytes]]:
@@ -613,10 +938,13 @@ class ConsensusReactor(Reactor):
     def _fallback_cap(self, sleep: float) -> float:
         return max(sleep * self.FALLBACK_CAP_MULTIPLIER, self.FALLBACK_CAP_FLOOR)
 
-    async def _gossip_wait(self, peer, event: asyncio.Event, cap: float) -> None:
+    async def _gossip_wait(self, peer, event: asyncio.Event, cap: float) -> bool:
         """Event-driven pacing: return as soon as a wakeup event fires;
         the reference's fixed sleep survives only as the fallback cap, so
         propagation latency is bounded by the event loop, not the tick.
+        Returns True iff an event carried the wakeup (False = the fallback
+        cap lapsed — the next pass is a REPAIR pass, exempt from the relay
+        topology's push gating so completeness never depends on it).
 
         NOT wait_for: on py3.10 a remove_peer/stop cancellation landing in
         the same tick the (constantly-fired) event completes would be
@@ -626,9 +954,10 @@ class ConsensusReactor(Reactor):
 
         fired = await wait_event(event, self._fallback_cap(cap))
         if not fired:
-            return
+            return False
         self.cs.metrics.gossip_wakeups.inc()
         self.cs.recorder.record("gossip.wakeup", peer=peer.id[:8])
+        return True
 
     async def _gossip_data_routine(self, peer, ps: PeerRoundState) -> None:
         """reactor.go:467, event-driven: one pass per wakeup, block parts
@@ -768,57 +1097,91 @@ class ConsensusReactor(Reactor):
         return sent > 0
 
     async def _gossip_votes_routine(self, peer, ps: PeerRoundState) -> None:
-        """reactor.go:606, event-driven + batched."""
+        """reactor.go:606, event-driven + batched + relay-gated.
+
+        `repair` tracks what carried the last wakeup: event-triggered
+        passes respect the relay topology (pushes go to the O(d) subset;
+        everyone else gets summaries only), a lapsed fallback cap makes
+        the next pass a repair pass that pushes to ANY peer — the
+        completeness guarantee the topology rides on."""
         sleep = self.cs.config.peer_gossip_sleep_duration
+        debounce = self.cs.config.gossip_relay_debounce
+        repair = True  # first pass services a freshly-added peer fully
         while True:
             ps.vote_wake.clear()
             rs = self.cs.rs
             sent = False
             if rs.height == ps.height:
-                sent = await self._gossip_votes_for_height(peer, ps)
+                sent = await self._gossip_votes_for_height(peer, ps, repair)
             elif rs.height == ps.height + 1 and rs.last_commit is not None:
                 sent = await self._send_votes(peer, ps, rs.last_commit)
             elif rs.height >= ps.height + 2 and ps.height >= self.cs.block_store.base():
                 commit = self.cs.block_store.load_block_commit(ps.height)
                 if commit is not None:
                     sent = await self._send_commit_votes(peer, ps, commit)
+            relay_on = (
+                debounce > 0
+                and self._relay_targets(self.cs.rs.height, self.cs.rs.round) is not None
+            )
+            if sent and relay_on:
+                # committee scale: cap the per-peer send cadence at the
+                # debounce so votes arriving meanwhile coalesce into the
+                # NEXT frame instead of trickling one frame each (the
+                # momentum loop otherwise defeats the coalescing below)
+                await asyncio.sleep(debounce)
             if not sent:
-                await self._gossip_wait(peer, ps.vote_wake, sleep)
+                fired = await self._gossip_wait(peer, ps.vote_wake, sleep)
+                repair = not fired
+                if fired and relay_on:
+                    # linger so the votes racing this wakeup coalesce into
+                    # ONE frame (the gossip twin of the engine's flush
+                    # quantum); the event re-sets under us, so nothing is
+                    # lost, only batched
+                    await asyncio.sleep(debounce)
 
-    async def _gossip_votes_for_height(self, peer, ps: PeerRoundState) -> bool:
+    async def _gossip_votes_for_height(
+        self, peer, ps: PeerRoundState, repair: bool = True
+    ) -> bool:
         """reactor.go:668 gossipVotesForHeight ordering."""
         rs = self.cs.rs
+        relay_ok = repair or self._relay_ok(peer.id)
         # peer in NewHeight: our last commit helps them finish their commit
         if ps.step == RoundStep.NEW_HEIGHT and rs.last_commit is not None:
-            if await self._send_votes(peer, ps, rs.last_commit):
+            if await self._send_votes(peer, ps, rs.last_commit, relay_ok):
                 return True
         # peer needs POL prevotes
         if ps.step <= RoundStep.PROPOSE and 0 <= ps.proposal_pol_round:
             pol = rs.votes.prevotes(ps.proposal_pol_round)
-            if pol is not None and await self._send_votes(peer, ps, pol):
+            if pol is not None and await self._send_votes(peer, ps, pol, relay_ok):
                 return True
         if ps.step <= RoundStep.PREVOTE_WAIT and 0 <= ps.round <= rs.round:
             vs = rs.votes.prevotes(ps.round)
-            if vs is not None and await self._send_votes(peer, ps, vs):
+            if vs is not None and await self._send_votes(peer, ps, vs, relay_ok):
                 return True
         if ps.step <= RoundStep.PRECOMMIT_WAIT and 0 <= ps.round <= rs.round:
             vs = rs.votes.precommits(ps.round)
-            if vs is not None and await self._send_votes(peer, ps, vs):
+            if vs is not None and await self._send_votes(peer, ps, vs, relay_ok):
                 return True
         if 0 <= ps.round <= rs.round:
             vs = rs.votes.prevotes(ps.round)
-            if vs is not None and await self._send_votes(peer, ps, vs):
+            if vs is not None and await self._send_votes(peer, ps, vs, relay_ok):
                 return True
         if 0 <= ps.proposal_pol_round:
             pol = rs.votes.prevotes(ps.proposal_pol_round)
-            if pol is not None and await self._send_votes(peer, ps, pol):
+            if pol is not None and await self._send_votes(peer, ps, pol, relay_ok):
                 return True
         return False
 
-    async def _send_votes(self, peer, ps: PeerRoundState, vote_set) -> bool:
-        """Send votes the peer lacks from one vote set.  Batched peers get
-        everything in one byte-capped vote_batch frame; legacy peers get
-        the reference's one-random-vote PickSendVote (reactor.go:1036)."""
+    async def _send_votes(
+        self, peer, ps: PeerRoundState, vote_set, relay_ok: bool = True
+    ) -> bool:
+        """Send votes the peer lacks from one vote set.  Once the set holds
+        +2/3, capable peers get a compact maj23 summary and pull what they
+        lack (aggregation) instead of a stream.  Below maj23, batched peers
+        get everything in one byte-capped vote_batch frame and legacy peers
+        the reference's one-random-vote PickSendVote (reactor.go:1036) —
+        but only relay targets / repair passes push at all when the relay
+        topology is active."""
         if vote_set is None:
             return False
         peer_bits = ps.get_vote_bits(
@@ -826,20 +1189,43 @@ class ConsensusReactor(Reactor):
         )
         if peer_bits is None:
             return False
+        # Aggregation only pays at committee scale: a summary→pull→batch
+        # exchange is two extra RTTs (plus the refresh floor) that a small
+        # net's laggard pays on the final vote of every step — measured 3×
+        # block time at 4 vals.  Gate it exactly like the relay topology:
+        # below gossip_relay_min_peers votes stream directly.
+        if (
+            self._relay_targets(self.cs.rs.height, self.cs.rs.round) is not None
+            and vote_set.has_two_thirds_majority()
+            and self._peer_summarized(peer)
+        ):
+            return await self._maybe_send_summary(peer, ps, vote_set)
+        if not relay_ok:
+            return False
         votes = vote_set.missing_votes(peer_bits)
         if not votes:
             return False
         if self._peer_batched(peer):
-            return await self._send_vote_batch(peer, ps, votes, vote_set.size())
+            return await self._send_vote_batch(
+                peer, ps, votes, vote_set.size(), have=vote_set
+            )
         return await self._send_single_vote(peer, ps, random.choice(votes), vote_set.size())
 
     async def _send_vote_batch(
-        self, peer, ps: PeerRoundState, votes: List[Vote], num_validators: int
+        self, peer, ps: PeerRoundState, votes: List[Vote], num_validators: int,
+        have=None,
     ) -> bool:
         """One frame, every missing vote up to the byte cap, each vote's
         wire bytes encoded once (types/vote.py Vote.wire) and shared
         across peers.  Anything over the cap rides the next wakeup (the
-        routine loops immediately after a successful send)."""
+        routine loops immediately after a successful send).
+
+        `have` (the source VoteSet/Commit) piggybacks our possession
+        bitmap on the frame: the receiver folds it into its belief of us,
+        so it never echoes these votes back and — since our bitmap covers
+        votes we got from THIRD parties — the epidemic push converges at
+        ~1 send per (edge, vote) instead of degree-fold duplication.
+        Older receivers ignore the extra fields (wire-compatible)."""
         cap = self.cs.config.gossip_vote_batch_bytes
         blobs: List[bytes] = []
         included: List[Vote] = []
@@ -853,7 +1239,13 @@ class ConsensusReactor(Reactor):
             blobs.append(w)
             included.append(v)
             total += len(w)
-        ok = await peer.send(VOTE_CHANNEL, _enc("vote_batch", {"votes": blobs}))
+        frame = {"votes": blobs}
+        if have is not None and included:
+            frame.update({
+                "h": have.height, "r": have.round, "t": have.signed_msg_type,
+                "have": have.bit_array().to_bytes(),
+            })
+        ok = await peer.send(VOTE_CHANNEL, _enc("vote_batch", frame))
         if ok:
             for v in included:
                 ps.set_has_vote(v.height, v.round, v.type, v.validator_index, num_validators)
@@ -955,6 +1347,13 @@ class ConsensusReactor(Reactor):
         }))
         if ok:
             ps.maj23_sent[key] = now
+            ps.prune_sent(ps.maj23_sent, now, now - resend_after)
+
+
+def _sent_time(v) -> float:
+    """Monotonic send time of a dedupe-map value — maj23_sent stores bare
+    floats, summary_sent stores (count, time) pairs."""
+    return v[1] if isinstance(v, tuple) else v
 
 
 def _enc(kind: str, fields: dict) -> bytes:

@@ -437,11 +437,23 @@ class BatchVerifier:
         _threading.Thread(target=_compile, daemon=False, name=f"bv-warmup-{b}").start()
         return False
 
+    # min_device_batch values past this can never be reached by a real
+    # batch: the engine is in permanent host-tier routing (e.g. a CPU-only
+    # box running a committee-scale rig) and pre-compiling device buckets
+    # would burn cores on kernels that will never dispatch.
+    _NEVER_DEVICE = 1 << 16
+
     def start_warmup(self) -> "BatchVerifier":
         """Enable cold-start host fallback and pre-compile the smallest
-        bucket (the shape every trickle of consensus votes lands in)."""
+        bucket that can actually dispatch — the first shape at or above
+        min_device_batch (verify() routes smaller batches to the host
+        tier, so warming below it is wasted compile).  With
+        min_device_batch effectively infinite, no bucket is compiled at
+        all: at 100 co-located nodes the eager per-node warmup compile
+        was measured stealing both cores for minutes."""
         self._warmup_mode = True
-        self._bucket_ready(self._bucket(1))
+        if self.min_device_batch < self._NEVER_DEVICE:
+            self._bucket_ready(self._bucket(max(1, self.min_device_batch)))
         return self
 
     def _use_pallas(self) -> bool:
@@ -968,6 +980,28 @@ class AsyncBatchVerifier(Service):
         self.verifier.recorder.record("verify.enqueue", pending=len(self._pending))
         self._note_arrival(now, accepted=1)
         return fut
+
+    async def verify_direct(
+        self, items: Sequence[Tuple[bytes, bytes, bytes]]
+    ) -> List[bool]:
+        """One PRE-BATCHED engine call on the flush executor, bypassing the
+        coalescing flusher.  A relay `vote_batch` already has the engine's
+        batch shape — routing it through verify_many buys nothing but two
+        extra scheduling hops (enqueue→flusher-wake→quantum-sleep→flush),
+        and on a congested loop (committee-scale in-proc nets run ~15k
+        tasks) each hop is a full ready-queue drain: measured seconds of
+        added latency per gossip hop at N=100.  The single flush-executor
+        worker keeps device dispatch serialized with regular flushes."""
+        if not items:
+            return []
+        pubkeys = [it[0] for it in items]
+        msgs = [it[1] for it in items]
+        sigs = [it[2] for it in items]
+        loop = asyncio.get_event_loop()
+        self.verifier.recorder.record("verify.direct_batch", n=len(items))
+        return await loop.run_in_executor(
+            self._executor, self.verifier.verify, pubkeys, msgs, sigs
+        )
 
     def verify_many(
         self, items: Sequence[Tuple[bytes, bytes, bytes]]

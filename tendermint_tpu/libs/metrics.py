@@ -67,6 +67,7 @@ class ConsensusMetrics:
                 "block_interval_seconds", "num_txs", "block_size_bytes",
                 "total_txs", "committed_height", "fast_syncing", "block_parts",
                 "gossip_wakeups", "vote_batch_size", "parts_per_burst",
+                "vote_summaries", "vote_pulls",
             ):
                 setattr(self, name, _NOP)
             return
@@ -128,6 +129,15 @@ class ConsensusMetrics:
             namespace=NAMESPACE, subsystem=sub, registry=registry,
             labelnames=("chain_id",), buckets=[1, 2, 4, 8, 16, 32, 64],
         ).labels(chain_id=chain_id)
+        # maj23 aggregation exchange (relay topology, gossip_version >= 2)
+        self.vote_summaries = g(
+            "vote_summaries",
+            "have-maj23 vote summaries sent instead of streaming votes.",
+        )
+        self.vote_pulls = g(
+            "vote_pulls",
+            "vote_pull requests served with a targeted vote_batch.",
+        )
 
 
 class P2PMetrics:

@@ -295,19 +295,24 @@ class Node(Service):
             from .mempool_reactor import MempoolReactor
             from .p2p import NodeInfo, NodeKey, Switch, Transport
 
-            from .p2p.node_info import GOSSIP_BATCH_VERSION
+            from .p2p.node_info import GOSSIP_BATCH_VERSION, GOSSIP_SUMMARY_VERSION
 
             self.node_key = NodeKey.load_or_gen(cfg.node_key_file())
+            # advertise the highest gossip capability the knobs enable;
+            # peers fall back per-level (2 → summary+batch, 1 → batch,
+            # 0 → the reference's single-vote messages), so mixed-version
+            # nets converge
+            if cfg.consensus.gossip_vote_batch and cfg.consensus.gossip_vote_summary:
+                gossip_version = GOSSIP_SUMMARY_VERSION
+            elif cfg.consensus.gossip_vote_batch:
+                gossip_version = GOSSIP_BATCH_VERSION
+            else:
+                gossip_version = 0
             node_info = NodeInfo(
                 node_id=self.node_key.id,
                 network=self.genesis_doc.chain_id,
                 moniker=cfg.base.moniker,
-                # advertise the vote_batch wire capability only when the
-                # knob is on; peers fall back to single-vote gossip for
-                # nodes advertising 0 (mixed-version convergence)
-                gossip_version=(
-                    GOSSIP_BATCH_VERSION if cfg.consensus.gossip_vote_batch else 0
-                ),
+                gossip_version=gossip_version,
             )
             transport = Transport(self.node_key, node_info)
             fuzz_config = None

@@ -211,7 +211,12 @@ class MConnection(Service):
                         continue
                     self._send_signal.clear()
                     try:
-                        await asyncio.wait_for(self._send_signal.wait(), timeout=0.1)
+                        # idle backstop only: sends AND pong-pending set the
+                        # signal, so nothing waits on this timeout.  It was
+                        # 0.1 s, which at a 100-node rig's ~700 connections
+                        # meant ~7000 no-op wakeups (each a wait_for task)
+                        # per second of pure idle churn on the event loop.
+                        await asyncio.wait_for(self._send_signal.wait(), timeout=2.0)
                     except asyncio.TimeoutError:
                         pass
                     # decay recently-sent so bursts don't starve low-priority

@@ -17,8 +17,13 @@ MAX_NUM_CHANNELS = 16
 # Consensus-gossip capability level advertised in NodeInfo.  0 = legacy
 # single-vote gossip (and what a peer whose handshake dict predates the
 # field resolves to, via from_dict's unknown-field tolerance); 1 = the
-# peer decodes byte-capped `vote_batch` frames on the VOTE channel.
+# peer decodes byte-capped `vote_batch` frames on the VOTE channel; 2 =
+# the peer additionally speaks the maj23 aggregation exchange
+# (`vote_summary` on STATE, `vote_pull` on VOTE_SET_BITS) used by the
+# degree-bounded relay topology at committee scale.  Capabilities are
+# cumulative: a v2 peer accepts everything a v1 peer does.
 GOSSIP_BATCH_VERSION = 1
+GOSSIP_SUMMARY_VERSION = 2
 
 
 @dataclass

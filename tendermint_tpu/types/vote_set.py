@@ -227,6 +227,32 @@ class VoteSet:
             if (v := self.votes[i]) is not None
         ]
 
+    def bits_we_lack(self, their_bits: Optional[BitArray]) -> BitArray:
+        """Bits set in `their_bits` but absent from our canonical set — what
+        a `vote_summary` receiver should pull from the sender.  Bits past
+        our validator-set size (a peer-supplied bitmap is attacker-sized)
+        are dropped, never allocated for."""
+        if their_bits is None:
+            return BitArray(0)
+        n = min(their_bits.bits, self.val_set.size())
+        theirs = BitArray(n)
+        theirs._v[:n] = their_bits._v[:n]
+        return theirs.sub(self.votes_bit_array)
+
+    def select_votes(self, bits: Optional[BitArray]) -> List[Vote]:
+        """Canonical votes at the true indices of `bits` (clamped to the
+        set size) — the serve side of a relay `vote_pull`.  Indices we hold
+        no vote for are skipped: the puller's bitmap is its claim about the
+        SENDER of a summary, which may not be us."""
+        if bits is None:
+            return []
+        n = min(bits.bits, len(self.votes))
+        return [
+            v
+            for i in bits.true_indices()
+            if i < n and (v := self.votes[i]) is not None
+        ]
+
     def get_by_address(self, address: bytes) -> Optional[Vote]:
         idx, val = self.val_set.get_by_address(address)
         if val is None:

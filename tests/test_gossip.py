@@ -171,6 +171,30 @@ class TestVerifyMany:
 
 
 class TestVoteBatchReceive:
+    async def test_large_batch_rides_the_direct_engine_path(self):
+        """Batches >= DIRECT_VERIFY_MIN skip the coalescing flusher and hit
+        the engine as ONE direct call (committee-scale hop latency), still
+        verified and landed."""
+        from tendermint_tpu.consensus.reactor import DIRECT_VERIFY_MIN
+
+        n = DIRECT_VERIFY_MIN + 4
+        vset, votes = _vset_and_votes(n)
+        cs = _FakeCS(vset)
+        cv = _CountingVerifier()
+        svc = AsyncBatchVerifier(cv)
+        await svc.start()
+        try:
+            reactor = ConsensusReactor(cs, async_verifier=svc)
+            reactor.switch = _FakeSwitch()
+            peer = SimpleNamespace(id="direct-peer-000", gossip_version=2)
+            reactor.peer_states[peer.id] = PeerRoundState()
+            await reactor.receive(VOTE_CHANNEL, peer, _batch_msg(votes))
+            assert len(cv.calls) == 1 and cv.calls[0] == n
+            assert len(cs.added) == n
+            assert all(verified for _, _, verified in cs.added)
+        finally:
+            await svc.stop()
+
     async def test_batch_is_one_engine_flush_and_lands_verified(self):
         vset, votes = _vset_and_votes(4)
         cs = _FakeCS(vset)
@@ -289,6 +313,238 @@ class TestMaj23Dedupe:
         assert ps.maj23_sent == {}
 
 
+class _CapturePeer:
+    """Fake peer capturing every (chan, decoded-kind, raw) send."""
+
+    def __init__(self, pid, gossip_version=2):
+        self.id = pid
+        self.gossip_version = gossip_version
+        self.sent = []
+
+    async def send(self, chan, msg):
+        d = codec.loads(msg)
+        self.sent.append((chan, d.pop("k"), d, msg))
+        return True
+
+    def kinds(self):
+        return [k for _, k, _, _ in self.sent]
+
+
+class TestRelayTopology:
+    def _reactor(self, n_peers=10, degree=3, min_peers=2, self_id="ee" * 20):
+        vset, _ = _vset_and_votes(4)
+        cs = _FakeCS(vset)
+        cs.config.gossip_relay_degree = degree
+        cs.config.gossip_relay_min_peers = min_peers
+        reactor = ConsensusReactor(cs)
+        reactor.switch = SimpleNamespace(node_id=self_id, peers={})
+        for i in range(n_peers):
+            reactor.peer_states[f"{i:02d}" * 20] = PeerRoundState()
+        return reactor
+
+    def test_degree_bounded_deterministic_and_rotating(self):
+        r = self._reactor()
+        t1 = r._relay_targets(5, 0)
+        assert t1 is not None and len(t1) == 3
+        assert r._relay_targets(5, 0) == t1  # cached + stable
+        # an independent reactor with the same peers and identity computes
+        # the SAME subset — the selection is a pure function of
+        # (height, round, edge ids), the property both endpoints rely on
+        assert self._reactor()._relay_targets(5, 0) == t1
+        # the subset rotates across rounds: a stuck round re-rolls the graph
+        union = set()
+        for rnd in range(8):
+            union |= r._relay_targets(5, rnd)
+        assert len(union) > 3
+        # and across heights
+        assert any(r._relay_targets(h, 0) != t1 for h in range(6, 10))
+
+    def test_full_mesh_below_thresholds(self):
+        assert self._reactor(degree=0)._relay_targets(5, 0) is None
+        assert self._reactor(n_peers=4, min_peers=8)._relay_targets(5, 0) is None
+        # degree >= peer count: relay pointless, full mesh
+        assert self._reactor(n_peers=3, degree=8)._relay_targets(5, 0) is None
+        r = self._reactor()
+        assert r._relay_ok(next(iter(r._relay_targets(5, 0))))
+
+    def test_peer_churn_invalidates_cache(self):
+        r = self._reactor()
+        t1 = r._relay_targets(5, 0)
+        r.peer_states["ff" * 20] = PeerRoundState()
+        r._peer_gen += 1  # what add_peer does
+        t2 = r._relay_targets(5, 0)
+        assert len(t2) == 3  # recomputed over the new peer set
+
+
+class TestVoteSummaryFlow:
+    async def test_summary_pull_batch_roundtrip(self):
+        """The aggregation exchange end to end: A (holds maj23) sends a
+        summary instead of streaming votes; B diffs the bitmap and pulls
+        exactly what it lacks; A serves one vote_batch; B verifies it as
+        ONE engine flush and lands every vote."""
+        vset, votes = _vset_and_votes(4)
+        cs_a = _FakeCS(vset)
+        # aggregation engages only at committee scale, gated exactly like
+        # the relay topology (small nets stream votes directly)
+        cs_a.config.gossip_relay_degree = 1
+        cs_a.config.gossip_relay_min_peers = 1
+        for v in votes:
+            cs_a.rs.votes.add_vote(v, verify=False)
+        vs_a = cs_a.rs.votes.prevotes(0)
+        assert vs_a.has_two_thirds_majority()
+
+        reactor_a = ConsensusReactor(cs_a)
+        reactor_a.switch = _FakeSwitch()
+        peer_b = _CapturePeer("bb" * 20)
+        ps_b = PeerRoundState()
+        ps_b.height = 5
+        reactor_a.peer_states[peer_b.id] = ps_b
+        reactor_a.peer_states["ff" * 20] = PeerRoundState()
+
+        # A: maj23 reached -> summary, not a vote stream
+        assert await reactor_a._send_votes(peer_b, ps_b, vs_a)
+        chan, kind, frame, raw = peer_b.sent[-1]
+        assert (chan, kind) == (0x20, "vote_summary")
+        assert BitArray.from_bytes(frame["votes"]).count() == 4
+        # deduped: an immediate second pass sends nothing new
+        assert not await reactor_a._send_votes(peer_b, ps_b, vs_a)
+        # ...but a grown bitmap would re-send (count check, not just time)
+
+        # B receives the summary and pulls everything it lacks
+        cs_b = _FakeCS(vset)
+        reactor_b = ConsensusReactor(cs_b)
+        reactor_b.switch = _FakeSwitch()
+        peer_a = _CapturePeer("aa" * 20)
+        ps_a = PeerRoundState()
+        ps_a.height = 5
+        reactor_b.peer_states[peer_a.id] = ps_a
+        await reactor_b.receive(0x20, peer_a, raw)
+        chan, kind, pull, pull_raw = peer_a.sent[-1]
+        assert (chan, kind) == (0x23, "vote_pull")
+        assert BitArray.from_bytes(pull["want"]).count() == 4
+        # the claim was recorded (maj23 machinery feeds VoteSetBits repair)
+        assert peer_a.id in cs_b.rs.votes.prevotes(0).peer_maj23s
+        # and the belief bits were folded in: B won't stream these back
+        assert ps_a.get_vote_bits(5, 0, PREVOTE_TYPE, 4).count() == 4
+
+        # A serves the pull as one byte-capped vote_batch
+        await reactor_a.receive(0x23, peer_b, pull_raw)
+        chan, kind, batch, batch_raw = peer_b.sent[-1]
+        assert (chan, kind) == (0x22, "vote_batch")
+        assert len(batch["votes"]) == 4
+
+        # B lands the batch as exactly one engine flush
+        cv = _CountingVerifier()
+        svc = AsyncBatchVerifier(cv)
+        await svc.start()
+        try:
+            reactor_b.async_verifier = svc
+            await reactor_b.receive(VOTE_CHANNEL, peer_a, batch_raw)
+            assert len(cv.calls) == 1 and cv.calls[0] == 4
+            assert len(cs_b.added) == 4
+            assert all(verified for _, _, verified in cs_b.added)
+        finally:
+            await svc.stop()
+
+    async def test_summary_only_to_capable_peers(self):
+        """A v1 (batch-only) peer must keep getting vote streams — the
+        summary exchange is negotiated, not assumed."""
+        vset, votes = _vset_and_votes(4)
+        cs = _FakeCS(vset)
+        for v in votes:
+            cs.rs.votes.add_vote(v, verify=False)
+        vs = cs.rs.votes.prevotes(0)
+        reactor = ConsensusReactor(cs)
+        reactor.switch = _FakeSwitch()
+        legacy = _CapturePeer("cc" * 20, gossip_version=1)
+        ps = PeerRoundState()
+        ps.height = 5
+        reactor.peer_states[legacy.id] = ps
+        assert await reactor._send_votes(legacy, ps, vs)
+        assert legacy.kinds() == ["vote_batch"]
+
+    async def test_malformed_summary_and_pull_stop_peer(self):
+        vset, _ = _vset_and_votes(4)
+        cs = _FakeCS(vset)
+        reactor = ConsensusReactor(cs)
+        reactor.switch = _FakeSwitch()
+        peer = _CapturePeer("dd" * 20)
+        reactor.peer_states[peer.id] = PeerRoundState()
+        await reactor.receive(0x20, peer, _enc("vote_summary", {
+            "height": 5, "round": 0, "type": PREVOTE_TYPE,
+            "block_id": {}, "votes": 123,  # not bytes
+        }))
+        assert reactor.switch.stopped
+        reactor.switch.stopped.clear()
+        await reactor.receive(0x23, peer, _enc("vote_pull", {
+            "height": "x", "round": 0, "type": PREVOTE_TYPE, "want": b"",
+        }))
+        assert reactor.switch.stopped
+
+
+class TestPeerStateBounds:
+    def test_round_tables_capped(self):
+        ps = PeerRoundState()
+        ps.height = 5
+        for r in range(PeerRoundState.MAX_TRACKED_ROUNDS * 3):
+            ps.get_vote_bits(5, r, PREVOTE_TYPE, 4)
+        assert len(ps.prevotes) == PeerRoundState.MAX_TRACKED_ROUNDS
+        # the newest rounds survive (they are the live ones)
+        assert max(ps.prevotes) == PeerRoundState.MAX_TRACKED_ROUNDS * 3 - 1
+        assert min(ps.prevotes) == PeerRoundState.MAX_TRACKED_ROUNDS * 2
+
+    def test_round_eviction_refuses_oldest_insert(self):
+        """Inserting a round OLDER than a full table must not evict the
+        just-inserted entry and then KeyError — it returns None (untracked),
+        and live newer rounds survive."""
+        ps = PeerRoundState()
+        ps.height = 5
+        base = 1000
+        for r in range(base, base + PeerRoundState.MAX_TRACKED_ROUNDS):
+            ps.get_vote_bits(5, r, PREVOTE_TYPE, 4)
+        assert ps.get_vote_bits(5, 0, PREVOTE_TYPE, 4) is None
+        assert len(ps.prevotes) == PeerRoundState.MAX_TRACKED_ROUNDS
+        assert min(ps.prevotes) == base
+
+    def test_vote_set_bits_unresolvable_height_skipped(self):
+        """num_validators == 0 (height doesn't pin to a set we hold) must
+        not create a permanent zero-size belief entry — set_has_vote on a
+        0-bit array is a no-op and every send pass would resend the full
+        batch forever."""
+        ps = PeerRoundState()
+        ps.height = 5
+        msg = {"height": 5, "round": 0, "type": PREVOTE_TYPE,
+               "votes": BitArray.from_indices(4, [0, 1]).to_bytes()}
+        ps.apply_vote_set_bits(msg, None, num_validators=0)
+        assert 0 not in ps.prevotes
+        ps.apply_vote_set_bits(msg, None, num_validators=4)
+        assert ps.prevotes[0].bits == 4
+
+    def test_sent_maps_pruned(self):
+        ps = PeerRoundState()
+        for i in range(400):
+            ps.maj23_sent[(5, i, 1, b"k")] = float(i)  # all "expired"
+        ps.prune_sent(ps.maj23_sent, now=1000.0, expired_before=500.0)
+        assert len(ps.maj23_sent) <= PeerRoundState.MAX_SENT_ENTRIES
+        for i in range(400):
+            ps.summary_sent[(5, i, 1)] = (4, 900.0)  # none expired
+        ps.prune_sent(ps.summary_sent, now=1000.0, expired_before=500.0)
+        assert len(ps.summary_sent) == PeerRoundState.MAX_SENT_ENTRIES
+
+    def test_vote_set_bits_allocation_clamped(self):
+        """The wire bitmap's length header is attacker-suppliable; sizing
+        a fresh per-round belief array from it let one frame allocate
+        gigabytes.  The allocation must clamp to OUR validator count."""
+        ps = PeerRoundState()
+        ps.height = 5
+        huge = (2**31).to_bytes(4, "big") + b"\xff" * 8
+        ps.apply_vote_set_bits(
+            {"height": 5, "round": 0, "type": PREVOTE_TYPE, "votes": huge},
+            None, num_validators=4,
+        )
+        assert ps.prevotes[0].bits <= 4
+
+
 # ---------------------------------------------------------------------------
 # live-net tests
 # ---------------------------------------------------------------------------
@@ -398,7 +654,9 @@ class TestMixedVersionInterop:
 
         nodes, _ = await _make_net(tmp_path, 3, name="mix", mutate_cfg=legacy_node2)
         try:
-            assert nodes[0].switch.node_info.gossip_version == GOSSIP_BATCH_VERSION
+            # batch-capable at least (a fully-featured node advertises the
+            # summary level on top — capabilities are cumulative)
+            assert nodes[0].switch.node_info.gossip_version >= GOSSIP_BATCH_VERSION
             assert nodes[2].switch.node_info.gossip_version == 0
             await _wait_all_height(nodes, 3)
             for h in range(1, 4):
@@ -426,6 +684,35 @@ class TestMixedVersionInterop:
                 assert any(
                     e["mode"] == "batch" and e["peer"] != legacy_prefix for e in evs
                 )
+        finally:
+            await _stop_net(nodes)
+
+
+class TestRelayLiveNet:
+    async def test_relay_net_commits_with_summaries(self, tmp_path):
+        """5 nodes with the relay topology FORCED on (degree 2 over 4
+        peers — event pushes reach half the mesh per round) and summaries
+        enabled: the net must still commit and agree, and the maj23
+        aggregation path must actually carry state (summaries recorded).
+        This is the liveness contract the 100-validator harness scales."""
+
+        def relay_cfg(i, cfg):
+            cfg.consensus.gossip_relay_degree = 2
+            cfg.consensus.gossip_relay_min_peers = 2
+
+        nodes, _ = await _make_net(tmp_path, 5, name="relay", mutate_cfg=relay_cfg)
+        try:
+            await _wait_all_height(nodes, 3)
+            for h in range(1, 4):
+                hashes = {n.block_store.load_block(h).hash() for n in nodes}
+                assert len(hashes) == 1, f"height {h} diverged"
+            kinds = set()
+            for n in nodes:
+                kinds |= {e["kind"] for e in n.flight_recorder.events()}
+            assert "gossip.summary" in kinds, (
+                "no vote summaries sent on a relay net that reached maj23"
+            )
+            assert "gossip.wakeup" in kinds
         finally:
             await _stop_net(nodes)
 

@@ -157,6 +157,110 @@ class TestPEXBootstrap:
                 if n.is_running:
                     await n.stop()
 
+    async def test_churn_soak_third_of_net_restarts_and_reconverges(self, tmp_path):
+        """PEX soak under churn (ROADMAP carried item): kill a third of a
+        PEX-discovered net, restart the victims on FRESH ports (durable stores,
+        same node keys), repeat — after every cycle the survivors must
+        re-mesh with the returnees, consensus must resume committing past
+        the pre-churn tip, and the victims' trust scores (decayed by the
+        survivors' failed dials while they were down) must recover once
+        outbound dials succeed again."""
+        import tendermint_tpu.p2p.pex.pex_reactor as pexmod
+
+        N, VICTIMS = 6, [4, 5]  # a third of the net
+        pvs = sorted([MockPV() for _ in range(N)], key=lambda pv: pv.address())
+        gen = _gen(pvs)
+
+        def mk_node(i):
+            cfg = make_test_cfg(str(tmp_path / f"churn{i}"))
+            cfg.rpc.laddr = ""
+            # DURABLE stores: a restarted validator must resume from its
+            # committed height — wiping a live validator's state re-signs
+            # old heights, which is self-equivocation and a (reference-
+            # correct) CONSENSUS FAILURE, not a churn scenario
+            cfg.base.db_backend = "sqlite"
+            cfg.p2p.laddr = "127.0.0.1:0"
+            cfg.p2p.addr_book_strict = False
+            cfg.consensus.skip_timeout_commit = False
+            cfg.consensus.timeout_commit = 0.1
+            return Node(cfg, gen, priv_validator=pvs[i])
+
+        nodes = [mk_node(i) for i in range(N)]
+        orig_fast = pexmod.FAST_ENSURE_INTERVAL
+        pexmod.FAST_ENSURE_INTERVAL = 0.2
+        try:
+            await nodes[0].start()
+            seed_addr = f"{nodes[0].node_key.id}@{nodes[0].switch.transport.listen_addr}"
+            for i in range(1, N):
+                nodes[i].config.p2p.seeds = seed_addr
+                await nodes[i].start()
+
+            async def meshed(min_peers=3):
+                while not all(
+                    n.switch.num_peers() >= min_peers for n in nodes if n.is_running
+                ):
+                    await asyncio.sleep(0.1)
+
+            async def committed(h):
+                while not all(n.block_store.height() >= h for n in nodes):
+                    await asyncio.sleep(0.1)
+
+            await asyncio.wait_for(meshed(), 60.0)
+            await asyncio.wait_for(committed(2), 60.0)
+
+            for cycle in range(2):
+                tip = max(n.block_store.height() for n in nodes)
+                victim_ids = [nodes[i].node_key.id for i in VICTIMS]
+                for i in VICTIMS:
+                    await nodes[i].stop()
+                # survivors notice and their dials fail: trust must decay
+                await asyncio.sleep(0.5)
+                book = nodes[1].addr_book
+                for vid in victim_ids:
+                    for _ in range(6):  # the switch's dial-failure feed
+                        book.mark_failed(vid)
+                decayed = {vid: book.trust_value(vid) for vid in victim_ids}
+                assert all(v < 1.0 for v in decayed.values())
+
+                # restart on fresh ports (same keys, stores resume)
+                for i in VICTIMS:
+                    nodes[i] = mk_node(i)
+                    nodes[i].config.p2p.seeds = seed_addr
+                    await nodes[i].start()
+                # deterministic outbound re-dial from the survivor whose
+                # trust we assert on (PEX would get here on its own tick)
+                for i in VICTIMS:
+                    addr = (
+                        f"{nodes[i].node_key.id}@"
+                        f"{nodes[i].switch.transport.listen_addr}"
+                    )
+                    assert await nodes[1].switch.dial_peer(addr) is not None
+
+                await asyncio.wait_for(meshed(), 60.0)
+                # consensus resumes past the pre-churn tip with ALL nodes
+                # (returnees resume from their stored height and catch up)
+                await asyncio.wait_for(committed(tip + 2), 90.0)
+                # dial success fed mark_good: trust recovers.  Polled, not
+                # point-sampled — PEX may still be re-dialing the victim's
+                # STALE pre-restart address in this window (mark_failed
+                # races the recovery), and the metric's idle-interval
+                # neutral entries need bucket rollovers to lift the score.
+                async def recovered():
+                    while any(
+                        book.trust_value(vid) <= decayed[vid] for vid in victim_ids
+                    ):
+                        await asyncio.sleep(0.5)
+                await asyncio.wait_for(recovered(), 45.0)
+
+            h = min(n.block_store.height() for n in nodes) - 1
+            hashes = {n.block_store.load_block(h).hash() for n in nodes}
+            assert len(hashes) == 1, f"net diverged at height {h}"
+        finally:
+            pexmod.FAST_ENSURE_INTERVAL = orig_fast
+            for n in nodes:
+                if n.is_running:
+                    await n.stop()
+
     async def test_unsolicited_pex_response_punished(self, tmp_path):
         from tendermint_tpu.encoding import codec
         from tendermint_tpu.p2p.pex import PEX_CHANNEL

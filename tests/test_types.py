@@ -415,6 +415,73 @@ class TestVoteSet:
         assert d.hash() == commit.hash()
 
 
+class TestVoteSetScaleQueries:
+    """The bitmap diff / selection queries the relay gossip pull path
+    exercises at committee scale (128 validators): sparse sets (a few
+    votes held, everything missing) and dense sets (one missing) are the
+    two edges the summary → pull → batch exchange lives on."""
+
+    N = 128
+
+    def _set(self, held):
+        vset, pvs = rand_validator_set(self.N, power=1)
+        vs = VoteSet(CHAIN_ID, 1, 0, PREVOTE_TYPE, vset)
+        bid = make_block_id()
+        for pv in pvs[:held]:
+            vs.add_vote(signed_vote(pv, vset, PREVOTE_TYPE, 1, 0, bid))
+        return vs, pvs, bid
+
+    def test_missing_votes_sparse(self):
+        from tendermint_tpu.libs.bitarray import BitArray
+
+        vs, pvs, _ = self._set(held=3)
+        # peer holds nothing: every vote we hold is missing for it
+        assert len(vs.missing_votes(BitArray(self.N))) == 3
+        assert len(vs.missing_votes(None)) == 3
+        # peer holds exactly what we hold: nothing to send
+        assert vs.missing_votes(vs.bit_array()) == []
+
+    def test_missing_votes_dense_one_lacking(self):
+        from tendermint_tpu.libs.bitarray import BitArray
+
+        vs, pvs, _ = self._set(held=self.N - 1)
+        peer_bits = vs.bit_array()
+        held_idx = peer_bits.true_indices()[7]
+        peer_bits.set_index(held_idx, False)
+        missing = vs.missing_votes(peer_bits)
+        assert len(missing) == 1 and missing[0].validator_index == held_idx
+
+    def test_bits_we_lack_clamps_and_diffs(self):
+        from tendermint_tpu.libs.bitarray import BitArray
+
+        vs, _, _ = self._set(held=3)
+        theirs = BitArray.from_indices(self.N, range(self.N))
+        lack = vs.bits_we_lack(theirs)
+        assert lack.count() == self.N - 3
+        assert not any(lack.get_index(i) for i in vs.bit_array().true_indices())
+        # an attacker-sized bitmap is clamped to the validator set, and
+        # None is an empty diff, not a crash
+        oversized = BitArray.from_indices(self.N * 4, range(self.N * 4))
+        assert vs.bits_we_lack(oversized).bits == self.N
+        assert vs.bits_we_lack(None).count() == 0
+
+    def test_select_votes_skips_unheld_and_clamps(self):
+        from tendermint_tpu.libs.bitarray import BitArray
+
+        vs, _, _ = self._set(held=3)
+        held = vs.bit_array().true_indices()
+        # want everything: only the 3 held votes come back
+        want_all = BitArray.from_indices(self.N * 2, range(self.N * 2))
+        got = vs.select_votes(want_all)
+        assert sorted(v.validator_index for v in got) == held
+        # want one held + one unheld: exactly the held one
+        unheld = next(i for i in range(self.N) if i not in held)
+        want = BitArray.from_indices(self.N, [held[0], unheld])
+        got = vs.select_votes(want)
+        assert [v.validator_index for v in got] == [held[0]]
+        assert vs.select_votes(None) == []
+
+
 # ---------------------------------------------------------------------------
 # blocks, headers, part sets
 # ---------------------------------------------------------------------------
