@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench bench-smoke trace-smoke statesync-smoke chaos-smoke scale-smoke localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke scale-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -20,6 +20,13 @@ trace-smoke:     ## short localnet; fails unless every block has a complete prop
 	$(PY) -m tendermint_tpu.cli testnet --validators 4 --output ./build-trace --base-port 28656 --fast
 	$(PY) networks/local/run_localnet.py ./build-trace --duration 8 --trace-check --json
 	rm -rf build-trace
+
+trace-net-smoke: ## 4-val localnet → dump every recorder → merged causal timeline + per-block loop attribution must be complete
+	rm -rf build-tracenet
+	$(PY) -m tendermint_tpu.cli testnet --validators 4 --output ./build-tracenet --base-port 28756 --fast
+	$(PY) networks/local/run_localnet.py ./build-tracenet --duration 8 --dump-recorders ./build-tracenet/dumps --json
+	$(PY) -m tendermint_tpu.cli trace-net ./build-tracenet/dumps/*.json --check
+	rm -rf build-tracenet
 
 statesync-smoke: ## empty 4th node joins a 3-val localnet via snapshot restore (fails on genesis replay)
 	$(PY) networks/local/statesync_smoke.py --json

@@ -312,7 +312,11 @@ def bench_e2e_4val_procs(duration: float = 12.0):
     test-grade timeouts, skip_timeout_commit, time_iota_ms=1 genesis).
     Readiness-gated by networks/local/run_localnet.py: the clock starts
     only after every node's RPC reports height >= 1, so per-process JAX
-    cold start is excluded.  Returns the run_localnet JSON result."""
+    cold start is excluded.  Runs with --trace-net: the four recorder
+    dumps must merge into one complete causal timeline with per-process
+    loop attribution (the trace-net-smoke gate, wired into the bench so
+    the cross-node tracing layer is exercised on every full run).
+    Returns the run_localnet JSON result."""
     import socket
     import subprocess
     import sys
@@ -348,7 +352,7 @@ def bench_e2e_4val_procs(duration: float = 12.0):
         )
         run = subprocess.run(
             [sys.executable, os.path.join(repo, "networks", "local", "run_localnet.py"),
-             build, "--duration", str(duration), "--json"],
+             build, "--duration", str(duration), "--trace-net", "--json"],
             capture_output=True, text=True, timeout=duration + 150, cwd=repo,
         )
         if run.returncode != 0:
@@ -385,8 +389,12 @@ def bench_scale_100val():
     in-process net (verify engine ON, chordal peer topology, relay gossip +
     maj23 vote aggregation) committing >= 10 consecutive blocks
     (networks/local/scale_smoke.py), plus a 50|50 partition/heal judged by
-    the chaos invariant checker.  Reports `e2e_commits_per_sec_100val` and
-    the gossip wakeup/batch telemetry from the flight recorders.  Raises
+    the chaos invariant checker.  Reports `e2e_commits_per_sec_100val`,
+    the gossip wakeup/batch telemetry, and the scheduler-profiler numbers
+    that replace the old "Python-loop-bound" narrative with measurement:
+    `loop_lag_ms_p90_100val`, `commit_skew_ms_100val` and
+    `block_attribution_100val` (loop-task / GC / loop-lag / idle shares
+    of each block's wall time, merged from all 100 recorders).  Raises
     if the net failed to commit, any invariant was violated, or the heal
     never recovered."""
     import subprocess
@@ -715,6 +723,14 @@ def main() -> None:
         "scale_100val_startup_s": scale.get("startup_s"),
         "scale_100val_engine_device_path": scale.get("engine_device_path"),
         "scale_100val_gossip": scale.get("gossip"),
+        "loop_lag_ms_p90_100val": scale.get("loop_lag_ms_p90_100val", -1.0),
+        "block_attribution_100val": scale.get("block_attribution_100val"),
+        "commit_skew_ms_100val": scale.get("commit_skew_ms_100val", -1.0),
+        "part_coverage_ms_p90_100val": scale.get("part_coverage_ms_p90_100val"),
+        "trace_net_4val": (procs.get("trace_net") or {}) and {
+            k: procs["trace_net"].get(k)
+            for k in ("heights", "commit_skew_ms_p90", "failures")
+        },
         "chaos_partition_recovery_ms_100val": scale.get(
             "chaos_partition_recovery_ms_100val"
         ),

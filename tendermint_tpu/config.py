@@ -268,6 +268,19 @@ class InstrumentationConfig:
     # own and costs ~1 µs/event, so it defaults on.
     flight_recorder: bool = True
     flight_recorder_size: int = 8192
+    # 1-in-N sampling for HIGH-RATE recorder kinds (gossip.wakeup fires
+    # per wakeup; at ~700 connections it can evict the whole ring between
+    # commits).  Sampled events carry `sampled=N` so consumers re-scale;
+    # 1 (default) records everything — the small-net behavior.
+    trace_sample_high_rate: int = 1
+    # Asyncio scheduler profiler (libs/loopprof.py): loop-lag probe,
+    # per-category task time accounting through Service.spawn, GC-pause
+    # hooks and queue-depth gauges — the `tendermint_loop_*` family plus
+    # `loop.*` recorder events.  Like the recorder it has no listener of
+    # its own; the accounting trampoline costs ~1 µs per task resume, so
+    # it defaults on.  `false` is a true no-op (spawn pays one None check).
+    loop_profiler: bool = True
+    loop_probe_interval: float = 0.25
 
 
 @dataclass
@@ -337,6 +350,10 @@ class Config:
             raise ValueError(f"unknown fastsync version {self.fast_sync.version!r}")
         if self.instrumentation.flight_recorder_size < 1:
             raise ValueError("instrumentation.flight_recorder_size must be >= 1")
+        if self.instrumentation.trace_sample_high_rate < 1:
+            raise ValueError("instrumentation.trace_sample_high_rate must be >= 1")
+        if self.instrumentation.loop_probe_interval <= 0:
+            raise ValueError("instrumentation.loop_probe_interval must be > 0")
         if self.consensus.gossip_part_burst < 1:
             raise ValueError("consensus.gossip_part_burst must be >= 1")
         if self.consensus.gossip_vote_batch_bytes < 1024:

@@ -669,7 +669,13 @@ class ConsensusReactor(Reactor):
             keep.append((vote, *resolved))
         if not keep:
             return
-        self.cs.recorder.record("gossip.vote_batch_recv", n=len(keep))
+        # provenance: the relay hop (peer) plus fresh-vs-already-held
+        # split — `n` fresh votes entered the verifier, `dup` were relays
+        # of votes this node already verified (first-seen vs relayed)
+        self.cs.recorder.record(
+            "gossip.vote_batch_recv", n=len(keep), dup=len(votes) - len(keep),
+            peer=peer.id[:8], h=keep[0][0].height, r=keep[0][0].round,
+        )
         results: List[Optional[bool]] = [None] * len(keep)
         engine: List[Tuple[int, bytes, bytes, bytes]] = []
         for i, (vote, pub_key, sign_bytes) in enumerate(keep):
@@ -956,7 +962,9 @@ class ConsensusReactor(Reactor):
         if not fired:
             return False
         self.cs.metrics.gossip_wakeups.inc()
-        self.cs.recorder.record("gossip.wakeup", peer=peer.id[:8])
+        # high-rate kind (fires per wakeup; ~700 conns can evict the whole
+        # ring between commits) — 1-in-N under trace_sample_high_rate
+        self.cs.recorder.record_sampled("gossip.wakeup", peer=peer.id[:8])
         return True
 
     async def _gossip_data_routine(self, peer, ps: PeerRoundState) -> None:
@@ -1002,7 +1010,9 @@ class ConsensusReactor(Reactor):
                     sent += 1
                 if sent:
                     self.cs.metrics.parts_per_burst.observe(sent)
-                    self.cs.recorder.record("gossip.part_burst", n=sent)
+                    self.cs.recorder.record(
+                        "gossip.part_burst", n=sent, peer=peer.id[:8]
+                    )
                 return sent > 0
         # 2. peer is catching up: burst parts of their next stored block
         if 0 < ps.height < rs.height and ps.height >= self.cs.block_store.base():
@@ -1093,7 +1103,9 @@ class ConsensusReactor(Reactor):
             sent += 1
         if sent:
             self.cs.metrics.parts_per_burst.observe(sent)
-            self.cs.recorder.record("gossip.part_burst", n=sent, catchup=True)
+            self.cs.recorder.record(
+                "gossip.part_burst", n=sent, peer=peer.id[:8], catchup=True
+            )
         return sent > 0
 
     async def _gossip_votes_routine(self, peer, ps: PeerRoundState) -> None:

@@ -325,6 +325,15 @@ class ConsensusState(Service):
                 had = self.rs.proposal is not None
                 await self.set_proposal(mi["proposal"])
                 if not had and self.rs.proposal is not None:
+                    # provenance: who BORN this proposal onto this node —
+                    # "self" is the proposer itself; a peer id prefix marks
+                    # a relay hop.  tracemerge keys "proposal born" on the
+                    # src="self" event across the merged dumps.
+                    p = self.rs.proposal
+                    self.recorder.record(
+                        "proposal", height=p.height, round=p.round,
+                        src=peer_id[:8] if peer_id else "self",
+                    )
                     for cb in self.on_proposal:
                         cb(self.rs)
             elif kind == "block_part":
@@ -748,7 +757,10 @@ class ConsensusState(Service):
             seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
             self.block_store.save_block(block, block_parts, seen_commit)
         fail_point("finalize-saved-block")
-        self.recorder.record("commit", height=block.height, txs=len(block.txs))
+        self.recorder.record(
+            "commit", height=block.height, txs=len(block.txs),
+            block=block.hash().hex()[:12],
+        )
         self._record_metrics(block)
 
         # end-height marker implies the block store has the block (wal.go:46)
@@ -874,6 +886,15 @@ class ConsensusState(Service):
                 )
                 raise PartSetError(f"proposal block does not decode: {e!r}") from e
             rs.proposal_block = block
+            # cross-node timeline: when THIS node first held the whole
+            # proposal — the per-node part-coverage point tracemerge
+            # aggregates into coverage p50/p90 across the net
+            self.recorder.record(
+                "block.parts_complete",
+                height=rs.height, round=round_,
+                parts=rs.proposal_block_parts.total,
+                src=peer_id[:8] if peer_id else "self",
+            )
             self.log.info(
                 "received complete proposal block",
                 height=rs.proposal_block.height,

@@ -932,7 +932,10 @@ class AsyncBatchVerifier(Service):
         # device dispatch serialized (the device is serial anyway).
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="bv-flush")
         self.verifier.start_warmup()  # compiles on its own thread; host path until warm
-        self._task = asyncio.create_task(self._flush_loop())
+        # via spawn, not bare create_task: the scheduler profiler's
+        # accounting trampoline rides the spawn path, and the flusher is
+        # exactly the "verify" loop occupancy the attribution table needs
+        self._task = self.spawn(self._flush_loop(), "flush-loop")
 
     async def on_stop(self) -> None:
         if self._task:

@@ -40,6 +40,19 @@ class _Nop:
 _NOP = _Nop()
 
 
+class _BoundLabels:
+    """Partially-bound labeled metric: fixes some label values (chain_id)
+    so call sites only supply their own dimension (category, queue) —
+    prometheus_client's .labels() demands every label at once."""
+
+    def __init__(self, metric, **bound):
+        self._metric = metric
+        self._bound = bound
+
+    def labels(self, **kw):
+        return self._metric.labels(**self._bound, **kw)
+
+
 class _ObservableGauge:
     """Gauge with an `observe` alias — callers use histogram-style
     .observe() while the exposed series stays a plain gauge, matching the
@@ -280,6 +293,60 @@ class VerifyMetrics:
         )
 
 
+class LoopMetrics:
+    """Asyncio scheduler profiler (subsystem `loop`; libs/loopprof.py —
+    no reference counterpart: Go's preemptive scheduler has no shared
+    cooperative loop to saturate).  Exposes the quantities that decide
+    whether a slow net is loop-bound: scheduled-vs-actual wakeup lag,
+    GC pause time, per-category task busy time and the depths of the
+    known choke-point queues."""
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        if registry is None:
+            self.lag_seconds = _NOP
+            self.gc_pause_seconds = _NOP
+            self.task_busy_seconds = _NOP
+            self.queue_depth = _NOP
+            return
+        from prometheus_client import Gauge, Histogram
+
+        sub = "loop"
+        time_buckets = [1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2,
+                        2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5]
+        self.lag_seconds = Histogram(
+            "lag_seconds",
+            "Scheduled-vs-actual wakeup delta of the loop-lag probe.",
+            namespace=NAMESPACE, subsystem=sub, registry=registry,
+            labelnames=("chain_id",), buckets=time_buckets,
+        ).labels(chain_id=chain_id)
+        self.gc_pause_seconds = Histogram(
+            "gc_pause_seconds",
+            "Garbage-collector pause time accumulated per probe interval.",
+            namespace=NAMESPACE, subsystem=sub, registry=registry,
+            labelnames=("chain_id",), buckets=time_buckets,
+        ).labels(chain_id=chain_id)
+        # labeled children resolved at use (.labels(category=...) /
+        # .labels(queue=...)) with chain_id pre-bound
+        self.task_busy_seconds = _BoundLabels(
+            Gauge(
+                "task_busy_seconds",
+                "Cumulative on-CPU task time per attribution category.",
+                namespace=NAMESPACE, subsystem=sub, registry=registry,
+                labelnames=("chain_id", "category"),
+            ),
+            chain_id=chain_id,
+        )
+        self.queue_depth = _BoundLabels(
+            Gauge(
+                "queue_depth",
+                "Sampled depth of a known choke-point queue.",
+                namespace=NAMESPACE, subsystem=sub, registry=registry,
+                labelnames=("chain_id", "queue"),
+            ),
+            chain_id=chain_id,
+        )
+
+
 class StateSyncMetrics:
     """Snapshot bootstrap (subsystem `statesync`): discovery and chunk
     transfer counters, restore-duration histogram, and the node's sync
@@ -418,6 +485,7 @@ class MetricsProvider:
         self.mempool = MempoolMetrics(self.registry, chain_id)
         self.state = StateMetrics(self.registry, chain_id)
         self.verify = VerifyMetrics(self.registry, chain_id)
+        self.loop = LoopMetrics(self.registry, chain_id)
         self.statesync = StateSyncMetrics(self.registry, chain_id)
         self.evidence = EvidenceMetrics(self.registry, chain_id)
         self.chaos = ChaosMetrics(self.registry, chain_id)

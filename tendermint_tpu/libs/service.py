@@ -13,6 +13,8 @@ import asyncio
 import logging
 from typing import Coroutine, Optional
 
+from . import loopprof
+
 
 class AlreadyStartedError(RuntimeError):
     pass
@@ -111,8 +113,17 @@ class Service:
         """Spawn a task owned by this service; cancelled on stop.
 
         The tracked-task pattern replaces the reference's per-service
-        goroutines + WaitGroups.
+        goroutines + WaitGroups.  When a scheduler profiler is installed
+        ([instrumentation] loop_profiler), the coroutine is wrapped in its
+        resume-timing trampoline and accounted to a category derived from
+        the service + task name — the spawn path is what makes per-
+        subsystem loop attribution free.  Disabled, this is one
+        module-global None check.
         """
+        if loopprof._ACTIVE is not None:
+            coro = loopprof._ACTIVE.wrap(
+                coro, loopprof.categorize(self._name, name)
+            )
         task = asyncio.get_event_loop().create_task(coro, name=name or self._name)
         if self._stopped:
             # Stop already ran (or is running) its cancel pass — a task

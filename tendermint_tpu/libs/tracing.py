@@ -13,7 +13,13 @@ Event kinds currently emitted:
 
   consensus (consensus/state.py):
     step              height, round, step      every H/R/S transition
-    commit            height, txs              block finalized
+    proposal          height, round, src       proposal accepted; src is the
+                                               delivering peer id prefix or
+                                               "self" when we proposed
+    block.parts_complete  height, round, parts, src   the proposal block
+                                               fully assembled on this node
+                                               (src delivered the last part)
+    commit            height, txs, block       block finalized (hash prefix)
   verify engine (crypto/batch_verifier.py):
     verify.enqueue    pending                  vote entered the batcher
     verify.enqueue_batch  n, pending           whole vote_batch entered as one arrival
@@ -24,10 +30,24 @@ Event kinds currently emitted:
     verify.table      hit, n                   TableCache lookup
   gossip (consensus/reactor.py, event-driven path):
     gossip.wakeup     peer                     routine woken by an event (not the
-                                               fallback sleep cap)
-    gossip.votes      mode, n, bytes           vote send: mode batch|single
-    gossip.vote_batch_recv  n                  decoded batch entered the verifier
-    gossip.part_burst n[, catchup]             block parts sent in one burst
+                                               fallback sleep cap); HIGH-RATE —
+                                               subject to trace_sample_high_rate
+    gossip.votes      mode, n, bytes, peer     vote send: mode batch|single
+    gossip.vote_batch_recv  n, dup, peer       decoded batch entered the verifier
+                                               (n fresh votes, dup already-held)
+    gossip.part_burst n, peer[, catchup]       block parts sent in one burst
+  scheduler profiler (libs/loopprof.py, [instrumentation] loop_profiler):
+    loop.lag          lag_ms                   scheduled-vs-actual probe wakeup
+                                               delta, once per probe interval
+    loop.busy         interval_ms, <category>_ms...   per-category on-CPU task
+                                               time accounted this interval
+                                               (consensus/gossip/p2p-conn/
+                                               verify/mempool/rpc/other)
+    loop.gc_pause     n, ms, max_ms            GC pauses accumulated this
+                                               interval (gc.callbacks hooks)
+    loop.queue        <name>=depth...          sampled queue depths (consensus
+                                               receive, verify pending, mconn
+                                               send, flush executor)
   statesync (statesync/syncer.py + reactor.py, bootstrap only):
     statesync.offer   height, format, chunks, result   snapshot offered to the app
     statesync.chunk   index, total, peer       chunk hash-verified + applied
@@ -45,7 +65,18 @@ Event kinds currently emitted:
                                                executed by the runner
 
 Events are flat dicts: {"seq", "t_ns", "kind", **fields}.  `t_ns` is
-time.monotonic_ns() — deltas are meaningful, wall-clock is not.
+time.monotonic_ns() — deltas are meaningful, wall-clock is not — but the
+recorder also carries a monotonic→wall ANCHOR (sampled at construction
+and re-sampled on every snapshot) so recorders dumped from DIFFERENT
+nodes can be aligned onto one wall timeline: wall(ev) = anchor.wall_ns +
+(ev.t_ns - anchor.mono_ns).  libs/tracemerge.py is the consumer.
+
+High-rate kinds (per-wakeup gossip events; ~700 connections can evict
+the entire ring between commits) go through `record_sampled`: with
+`[instrumentation] trace_sample_high_rate` = N only 1-in-N events is
+stored, and the stored event carries `sampled=N` so consumers can
+re-scale counts.  N=1 (default) preserves the record-everything behavior
+small nets want.
 
 Performance contract: `record` on a disabled recorder (or the module NOP)
 is one attribute check; enabled it is one uncontended lock, one
@@ -60,7 +91,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional, Sequence
 
 
 class NopRecorder:
@@ -68,14 +99,18 @@ class NopRecorder:
 
     enabled = False
     size = 0
+    sample_high_rate = 1
 
     def record(self, kind: str, **fields) -> None:
         pass
 
-    def events(self, since: int = 0) -> List[dict]:
+    def record_sampled(self, kind: str, **fields) -> None:
+        pass
+
+    def events(self, since: int = 0, kinds=None) -> List[dict]:
         return []
 
-    def snapshot(self, since: int = 0) -> dict:
+    def snapshot(self, since: int = 0, kinds=None) -> dict:
         return {"enabled": False, "size": 0, "next_seq": 0, "events": []}
 
 
@@ -86,19 +121,39 @@ class FlightRecorder:
     """Fixed-size ring of span events; `enabled=False` degrades to the nop
     fast path while keeping one object type at every call site."""
 
-    __slots__ = ("size", "enabled", "_buf", "_seq", "_lock")
+    __slots__ = (
+        "size", "enabled", "sample_high_rate", "_buf", "_seq", "_lock",
+        "_sample_counts", "_wall_ns_fn", "anchor_mono_ns", "anchor_wall_ns",
+    )
 
-    def __init__(self, size: int = 8192, enabled: bool = True):
+    def __init__(
+        self,
+        size: int = 8192,
+        enabled: bool = True,
+        sample_high_rate: int = 1,
+        wall_ns_fn: Callable[[], int] = time.time_ns,
+    ):
         if size < 1:
             raise ValueError("flight recorder size must be >= 1")
+        if sample_high_rate < 1:
+            raise ValueError("trace_sample_high_rate must be >= 1")
         self.size = size
         self.enabled = enabled
+        self.sample_high_rate = sample_high_rate
         self._buf: List[Optional[tuple]] = [None] * size
         self._seq = 0  # next sequence number; monotonic, never wraps
         # an uncontended Lock costs ~0.1 µs and guarantees seq order ==
         # timestamp order across writer threads (the monotonicity the
         # span-chain consumers rely on)
         self._lock = threading.Lock()
+        self._sample_counts: dict = {}
+        # monotonic→wall anchor: lets tracemerge place this recorder's
+        # t_ns events on a wall timeline shared with OTHER nodes' dumps.
+        # wall_ns_fn is pluggable so a chaos SkewedClock (and its tests)
+        # can skew what this node believes wall time is.
+        self._wall_ns_fn = wall_ns_fn
+        self.anchor_mono_ns = time.monotonic_ns()
+        self.anchor_wall_ns = wall_ns_fn()
 
     def record(self, kind: str, **fields) -> None:
         if not self.enabled:
@@ -108,11 +163,37 @@ class FlightRecorder:
             self._seq = i + 1
             self._buf[i % self.size] = (i, time.monotonic_ns(), kind, fields)
 
-    def events(self, since: int = 0) -> List[dict]:
-        """Events still in the ring with seq >= since, oldest first."""
+    def record_sampled(self, kind: str, **fields) -> None:
+        """1-in-N recording for high-rate kinds (gossip.wakeup fires per
+        wakeup — at committee scale it can evict the whole ring between
+        commits).  The stored event carries `sampled=N` so consumers can
+        re-scale counts; N=1 is a plain record (small-net default)."""
+        if not self.enabled:
+            return
+        n = self.sample_high_rate
+        if n <= 1:
+            self.record(kind, **fields)
+            return
+        with self._lock:
+            c = self._sample_counts.get(kind, 0) + 1
+            self._sample_counts[kind] = 0 if c >= n else c
+            if c != 1:  # store the 1st of every N
+                return
+            fields["sampled"] = n
+            i = self._seq
+            self._seq = i + 1
+            self._buf[i % self.size] = (i, time.monotonic_ns(), kind, fields)
+
+    def events(self, since: int = 0, kinds: Optional[Sequence[str]] = None) -> List[dict]:
+        """Events still in the ring with seq >= since, oldest first.
+        `kinds` filters by prefix match (["gossip.", "step"] keeps every
+        gossip event and the step transitions)."""
         out = []
+        pref = tuple(kinds) if kinds else None
         for ev in self._buf:
             if ev is not None and ev[0] >= since:
+                if pref is not None and not ev[2].startswith(pref):
+                    continue
                 out.append(ev)
         out.sort(key=lambda ev: ev[0])
         return [
@@ -120,16 +201,23 @@ class FlightRecorder:
             for seq, t_ns, kind, fields in out
         ]
 
-    def snapshot(self, since: int = 0) -> dict:
+    def snapshot(self, since: int = 0, kinds: Optional[Sequence[str]] = None) -> dict:
         """The dump_flight_recorder RPC payload.  `next_seq` lets a poller
         pass it back as `since` to stream only fresh events; dropped =
-        events that aged out of the ring before this snapshot."""
-        events = self.events(since)
+        events that aged out of the ring before this snapshot.  `anchor`
+        is RE-SAMPLED here (monotonic and wall read back-to-back) so a
+        long-lived node's dump carries a fresh mapping — NTP slew between
+        start and dump would otherwise skew cross-node alignment."""
+        events = self.events(since, kinds)
+        mono = time.monotonic_ns()
+        wall = self._wall_ns_fn()
         return {
             "enabled": self.enabled,
             "size": self.size,
             "next_seq": self._seq,
+            "since": since,
             "dropped": max(0, self._seq - self.size),
+            "anchor": {"mono_ns": mono, "wall_ns": wall},
             "events": events,
         }
 
@@ -157,6 +245,45 @@ def complete_heights(chains: dict) -> List[int]:
     return sorted(
         h for h, steps in chains.items() if all(s in steps for s in REQUIRED_STEPS)
     )
+
+
+def span_report(events: List[dict], dropped: int = 0, since: int = 0) -> dict:
+    """Classify every interior recorded height's span chain:
+
+      complete   — full propose→commit chain present
+      truncated  — missing steps are exactly a PREFIX of the required
+                   chain while the ring wrapped (dropped > 0) or the dump
+                   was watermarked (since > 0): eviction is strictly
+                   oldest-first, so a busy ring legitimately ages out the
+                   EARLY steps of a height whose commit is still fresh.
+                   Not a failure — `trace --check` used to hard-fail here,
+                   which made it useless exactly on the busy nets it is
+                   for.
+      bad        — {height: missing_steps} with a mid-chain or suffix
+                   hole: a LATER step present while an earlier one is
+                   missing cannot be eviction (later events are newer) and
+                   is a real instrumentation/consensus bug.
+
+    Edge heights (first/last recorded) are excluded as before — startup
+    and the dump instant truncate them trivially."""
+    chains = step_chains(events)
+    heights = sorted(chains)
+    interior = heights[1:-1]
+    wrapped = (dropped or 0) > 0 or (since or 0) > 0
+    complete: List[int] = []
+    truncated: List[int] = []
+    bad: dict = {}
+    for h in interior:
+        steps = chains[h]
+        missing = [s for s in REQUIRED_STEPS if s not in steps]
+        if not missing:
+            complete.append(h)
+        elif wrapped and tuple(missing) == REQUIRED_STEPS[: len(missing)]:
+            truncated.append(h)
+        else:
+            bad[h] = missing
+    return {"complete": complete, "truncated": truncated, "bad": bad,
+            "interior": len(interior)}
 
 
 def block_breakdown(events: List[dict]) -> Optional[dict]:

@@ -127,14 +127,16 @@ class Node(Service):
         self.metrics_provider = None
         self.metrics_server = None
         self.grpc_server = None
+        self.loop_profiler = None
         # flight recorder: always constructed (cheap), so the RPC dump
-        # route exists whether or not prometheus is on; enabled/size from
-        # the [instrumentation] config section
+        # route exists whether or not prometheus is on; enabled/size/
+        # high-rate sampling from the [instrumentation] config section
         from .libs.tracing import FlightRecorder
 
         self.flight_recorder = FlightRecorder(
             size=config.instrumentation.flight_recorder_size,
             enabled=config.instrumentation.flight_recorder,
+            sample_high_rate=config.instrumentation.trace_sample_high_rate,
         )
 
     async def on_start(self) -> None:
@@ -149,6 +151,20 @@ class Node(Service):
         from .crypto import backend as _crypto_backend
 
         self.metrics_provider.verify.backend_tier.set(_crypto_backend.active_tier())
+        # scheduler profiler, started BEFORE any service spawns tasks so
+        # the spawn-path accounting trampoline covers them all.  The spawn
+        # and GC hooks are process-wide first-wins (libs/loopprof.py):
+        # in-proc multi-node rigs get one process attribution via the
+        # first node, per-node lag/queue probes everywhere.
+        if cfg.instrumentation.loop_profiler:
+            from .libs.loopprof import LoopProfiler
+
+            self.loop_profiler = LoopProfiler(
+                interval=cfg.instrumentation.loop_probe_interval,
+                metrics=self.metrics_provider.loop,
+                recorder=self.flight_recorder,
+            )
+            await self.loop_profiler.start()
         # TPU batch-verify engine first: every downstream consumer of
         # crypto.batch.get_verifier() (handshake replay, fastsync,
         # verify_commit in block validation) must already see the device
@@ -269,6 +285,11 @@ class Node(Service):
                 recorder=self.flight_recorder,
             )
             self.consensus.clock = self.chaos_clock
+            # the recorder's monotonic→wall dump anchor reads the SAME
+            # skewed wall clock, so cross-node trace alignment sees the
+            # fault the scenario injected (tracemerge's causal pass is
+            # what detects and corrects it)
+            self.flight_recorder._wall_ns_fn = self.chaos_clock.time_ns
         if self.priv_validator is not None:
             self.consensus.set_priv_validator(self.priv_validator)
         cfg.ensure_dirs()
@@ -474,11 +495,46 @@ class Node(Service):
             )
             await self.metrics_server.start()
             self.log.info("prometheus metrics", laddr=self.metrics_server.bound_addr)
+        if self.loop_profiler is not None:
+            self._register_queue_probes()
         self.log.info(
             "node started",
             chain_id=self.genesis_doc.chain_id,
             height=self.state.last_block_height,
         )
+
+    def _register_queue_probes(self) -> None:
+        """Wire the known choke-point queues into the scheduler profiler's
+        per-tick `loop.queue` sampling: the consensus receive queue, the
+        AsyncBatchVerifier's pending list + flush-executor backlog, and
+        the aggregate MConnection send-queue depth across peers."""
+        prof = self.loop_profiler
+        if self.consensus is not None:
+            prof.add_queue_probe("cs_recv", self.consensus.msg_queue.qsize)
+        if self.async_verifier is not None:
+            verifier = self.async_verifier
+            prof.add_queue_probe("verify_pending", lambda: len(verifier._pending))
+
+            def _executor_backlog() -> int:
+                ex = verifier._executor
+                q = getattr(ex, "_work_queue", None)
+                return q.qsize() if q is not None else 0
+
+            prof.add_queue_probe("flush_executor", _executor_backlog)
+        if self.switch is not None:
+            switch = self.switch
+
+            def _mconn_send_depth() -> int:
+                total = 0
+                for peer in list(switch.peers.values()):
+                    mconn = getattr(peer, "mconn", None)
+                    if mconn is None:
+                        continue
+                    for ch in mconn.channels.values():
+                        total += ch.send_queue.qsize()
+                return total
+
+            prof.add_queue_probe("mconn_send", _mconn_send_depth)
 
     async def _statesync_done(self, state) -> None:
         """Statesync → fastsync handover (or fallback).  `state` is the
@@ -504,6 +560,8 @@ class Node(Service):
         await self.blockchain_reactor.switch_to_fastsync(self.state)
 
     async def on_stop(self) -> None:
+        if self.loop_profiler is not None:
+            await self.loop_profiler.stop()
         if self.metrics_server is not None:
             await self.metrics_server.stop()
         if self.switch is not None:

@@ -412,16 +412,32 @@ class RPCCore:
                 )
         return {"round_state": self._round_state_dict(full=True), "peers": peers}
 
-    async def dump_flight_recorder(self, since: int = 0) -> dict:
+    async def dump_flight_recorder(self, since: int = 0, kinds=None) -> dict:
         """Drain the node's flight recorder (libs/tracing.py): the ring of
-        consensus-step and verify-engine span events.  `since` is a seq
-        watermark — pass the previous response's `next_seq` to poll only
-        fresh events.  Safe route: bounded payload (ring-sized), no node
-        mutation."""
+        consensus-step, gossip, verify-engine and scheduler-profiler span
+        events.  `since` is a seq watermark — pass the previous response's
+        `next_seq` to poll only fresh events.  `kinds` filters by event-
+        kind prefix (list, or comma-separated string: "step,gossip."); the
+        snapshot carries a freshly-sampled monotonic→wall `anchor` plus
+        this node's moniker so `trace-net` can merge dumps from different
+        nodes onto one timeline.  Safe route: bounded payload (ring-
+        sized), no node mutation."""
         rec = getattr(self.node, "flight_recorder", None)
         if rec is None:
             return {"enabled": False, "size": 0, "next_seq": 0, "dropped": 0, "events": []}
-        return rec.snapshot(since=since)
+        if isinstance(kinds, str):
+            kinds = [k for k in kinds.split(",") if k]
+        elif kinds is not None:
+            # caller-supplied over HTTP: keep only string entries instead
+            # of letting a junk element TypeError inside the ring scan
+            kinds = [k for k in kinds if isinstance(k, str)] if isinstance(
+                kinds, (list, tuple)
+            ) else None
+        snap = rec.snapshot(since=int(since), kinds=kinds or None)
+        cfg = getattr(self.node, "config", None)
+        if cfg is not None:
+            snap["node"] = cfg.base.moniker
+        return snap
 
     # -- mempool routes ----------------------------------------------------
 

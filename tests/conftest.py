@@ -37,6 +37,24 @@ def event_loop():
     loop.close()
 
 
+@pytest.fixture(autouse=True)
+def _loopprof_hook_guard():
+    """The scheduler profiler's spawn + GC hooks are process-wide
+    (libs/loopprof.py); a test that crashes between a Node's start and
+    stop would leak them into every later test's Service.spawn.  Restore
+    a clean slate after each test."""
+    yield
+    import gc
+
+    from tendermint_tpu.libs import loopprof
+
+    prof = loopprof._ACTIVE
+    if prof is not None:
+        loopprof._ACTIVE = None
+        if prof._gc_cb is not None and prof._gc_cb in gc.callbacks:
+            gc.callbacks.remove(prof._gc_cb)
+
+
 def pytest_collection_modifyitems(config, items):
     # Provide asyncio support without the pytest-asyncio plugin: run
     # coroutine tests on a fresh event loop.

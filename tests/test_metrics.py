@@ -91,7 +91,7 @@ class TestNopDriftGuard:
         names = {cls.__name__ for cls in classes}
         assert {
             "ConsensusMetrics", "P2PMetrics", "MempoolMetrics",
-            "StateMetrics", "VerifyMetrics",
+            "StateMetrics", "VerifyMetrics", "LoopMetrics",
         } <= names
         for cls in classes:
             nop = cls(None, "drift-chain")
@@ -103,8 +103,30 @@ class TestNopDriftGuard:
 
     def test_provider_exposes_every_subsystem(self):
         p = MetricsProvider(True, CHAIN_ID)
-        for sub in ("consensus", "p2p", "mempool", "state", "verify"):
+        for sub in ("consensus", "p2p", "mempool", "state", "verify", "loop"):
             assert getattr(p, sub) is not None
+
+    def test_loop_family_exports_under_reference_names(self):
+        # the scheduler-profiler series: histograms bound to chain_id at
+        # construction (count series exist even before any observation),
+        # labeled gauges resolved per category/queue at use
+        p = MetricsProvider(True, CHAIN_ID)
+        p.loop.lag_seconds.observe(0.003)
+        p.loop.gc_pause_seconds.observe(0.001)
+        p.loop.task_busy_seconds.labels(category="consensus").set(1.5)
+        p.loop.queue_depth.labels(queue="cs_recv").set(42)
+        metrics = _parse(p.exposition().decode())
+        key = f'chain_id="{CHAIN_ID}"'
+        assert metrics[f"tendermint_loop_lag_seconds_count{{{key}}}"] == 1
+        assert metrics[f"tendermint_loop_gc_pause_seconds_count{{{key}}}"] == 1
+        busy = [v for k, v in metrics.items()
+                if k.startswith("tendermint_loop_task_busy_seconds{")
+                and 'category="consensus"' in k]
+        assert busy == [1.5]
+        depth = [v for k, v in metrics.items()
+                 if k.startswith("tendermint_loop_queue_depth{")
+                 and 'queue="cs_recv"' in k]
+        assert depth == [42]
 
 
 class TestMetricsServer:
@@ -160,6 +182,9 @@ class TestLiveScrape:
             cfg.p2p.laddr = "127.0.0.1:0"
             cfg.consensus.skip_timeout_commit = False
             cfg.consensus.timeout_commit = 0.05
+            # scheduler-profiler probe must tick inside the short run so
+            # the loop series and loop.* recorder events populate
+            cfg.instrumentation.loop_probe_interval = 0.02
             if i == 0:
                 cfg.instrumentation.prometheus = True
                 cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
@@ -229,6 +254,25 @@ class TestLiveScrape:
             )
             assert sent > 0, "peer_send_bytes_total never incremented"
 
+            # scheduler-profiler family: the lag probe observed, per-
+            # category busy gauges are live (node0 owns the process hooks
+            # — it started first), and the choke-point queues are sampled
+            assert metrics[f"tendermint_loop_lag_seconds_count{{{key}}}"] > 0
+            assert f"tendermint_loop_gc_pause_seconds_count{{{key}}}" in metrics
+            busy = {
+                k: v for k, v in metrics.items()
+                if k.startswith("tendermint_loop_task_busy_seconds{")
+            }
+            assert any(v > 0 for v in busy.values()), f"no busy category live: {busy}"
+            depths = {
+                k: v for k, v in metrics.items()
+                if k.startswith("tendermint_loop_queue_depth{")
+            }
+            for q in ("cs_recv", "verify_pending", "flush_executor", "mconn_send"):
+                assert any(f'queue="{q}"' in k for k in depths), (
+                    f"queue probe {q} never sampled: {sorted(depths)}"
+                )
+
             # flight recorder via the RPC route: complete, monotonic span
             # chains for the committed heights
             from tendermint_tpu.libs import tracing
@@ -242,6 +286,38 @@ class TestLiveScrape:
             complete = tracing.complete_heights(chains)
             assert len(complete) >= 2, f"no complete span chains: {chains}"
             assert any(e["kind"] == "verify.flush" for e in snap["events"])
+
+            # cross-node tracing surface survives the RPC round-trip:
+            # the monotonic→wall anchor, the node label, and the new
+            # provenance fields on proposal/commit/gossip events
+            assert set(snap["anchor"]) == {"mono_ns", "wall_ns"}
+            assert abs(snap["anchor"]["wall_ns"] - __import__("time").time_ns()) < 60e9
+            assert snap["node"] == nodes[0].config.base.moniker
+            props = [e for e in snap["events"] if e["kind"] == "proposal"]
+            assert props, "no proposal events recorded"
+            peer_prefix = nodes[1].node_key.id[:8]
+            assert all(e["src"] in ("self", peer_prefix) for e in props)
+            assert {e["src"] for e in props} == {"self", peer_prefix}, (
+                "expected both self-born and relayed proposals in a 2-val net"
+            )
+            commits = [e for e in snap["events"] if e["kind"] == "commit"]
+            assert commits and all(
+                isinstance(e["block"], str) and len(e["block"]) == 12 for e in commits
+            )
+            recvs = [e for e in snap["events"] if e["kind"] == "gossip.vote_batch_recv"]
+            assert recvs, "no vote batches received"
+            assert all(e["peer"] == peer_prefix and e["dup"] >= 0 for e in recvs)
+            # scheduler-profiler events ride the same dump
+            loop_kinds = {e["kind"] for e in snap["events"] if e["kind"].startswith("loop.")}
+            assert {"loop.lag", "loop.busy", "loop.queue"} <= loop_kinds, loop_kinds
+
+            # kinds prefix filtering through the RPC route (string form)
+            filt = await RPCCore(nodes[0]).call(
+                "dump_flight_recorder", {"kinds": "step,commit"}
+            )
+            assert filt["events"] and all(
+                e["kind"] in ("step", "commit") for e in filt["events"]
+            )
         finally:
             for n in nodes:
                 if n.is_running:

@@ -54,6 +54,135 @@ class TestRing:
         assert per_event < 5e-6, f"record() took {per_event * 1e6:.2f} us/event"
 
 
+class TestSampling:
+    def test_one_in_n_with_factor_recorded(self):
+        r = FlightRecorder(size=256, sample_high_rate=4)
+        for _ in range(16):
+            r.record_sampled("gossip.wakeup", peer="ab")
+        evs = r.events()
+        assert len(evs) == 4  # 1-in-4
+        assert all(e["sampled"] == 4 for e in evs)
+        # consumers re-scale by the recorded factor
+        assert sum(e["sampled"] for e in evs) == 16
+
+    def test_default_factor_preserves_record_everything(self):
+        r = FlightRecorder(size=256)  # sample_high_rate=1, the small-net default
+        for _ in range(10):
+            r.record_sampled("gossip.wakeup", peer="ab")
+        evs = r.events()
+        assert len(evs) == 10
+        assert all("sampled" not in e for e in evs)
+
+    def test_counters_are_per_kind_and_low_rate_kinds_unaffected(self):
+        r = FlightRecorder(size=256, sample_high_rate=8)
+        for i in range(8):
+            r.record_sampled("gossip.wakeup", peer="ab")
+            r.record("commit", height=i)  # plain record never sampled
+        kinds = [e["kind"] for e in r.events()]
+        assert kinds.count("gossip.wakeup") == 1
+        assert kinds.count("commit") == 8
+
+    def test_disabled_recorder_samples_nothing(self):
+        r = FlightRecorder(size=8, enabled=False, sample_high_rate=4)
+        r.record_sampled("gossip.wakeup")
+        assert r.events() == []
+        NopRecorder().record_sampled("gossip.wakeup")  # must not raise
+
+    def test_factor_must_be_positive(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            FlightRecorder(size=8, sample_high_rate=0)
+
+
+class TestKindsFilterAndAnchor:
+    def test_events_kinds_prefix_filter(self):
+        r = FlightRecorder(size=64)
+        r.record("step", height=1, step="Propose")
+        r.record("gossip.wakeup", peer="ab")
+        r.record("gossip.votes", n=2)
+        r.record("verify.flush", batch=2)
+        assert [e["kind"] for e in r.events(kinds=["gossip."])] == [
+            "gossip.wakeup", "gossip.votes",
+        ]
+        assert [e["kind"] for e in r.events(kinds=["step", "verify."])] == [
+            "step", "verify.flush",
+        ]
+        snap = r.snapshot(kinds=["step"])
+        assert [e["kind"] for e in snap["events"]] == ["step"]
+        assert snap["next_seq"] == 4  # watermark unaffected by the filter
+
+    def test_anchor_present_and_resampled_on_snapshot(self):
+        r = FlightRecorder(size=8)
+        a1 = r.snapshot()["anchor"]
+        assert a1["mono_ns"] >= r.anchor_mono_ns
+        assert set(a1) == {"mono_ns", "wall_ns"}
+        time.sleep(0.002)
+        a2 = r.snapshot()["anchor"]
+        # re-sampled at dump time, not the construction-time anchor
+        assert a2["mono_ns"] > a1["mono_ns"]
+
+    def test_anchor_wall_fn_pluggable_via_skewed_clock(self):
+        from tendermint_tpu.chaos.clock import SkewedClock
+
+        clock = SkewedClock(3.0)
+        r = FlightRecorder(size=8, wall_ns_fn=clock.time_ns)
+        a = r.snapshot()["anchor"]
+        assert abs(a["wall_ns"] - 3_000_000_000 - time.time_ns()) < 1_000_000_000
+
+
+class TestSpanReport:
+    def _events(self, spec):
+        """spec: {height: [steps]} recorded in height order."""
+        r = FlightRecorder(size=1024)
+        for h in sorted(spec):
+            for step in spec[h]:
+                r.record("step", height=h, round=0, step=step)
+        return r.events()
+
+    def test_complete_interior_heights(self):
+        evs = self._events({h: list(tracing.REQUIRED_STEPS) for h in (1, 2, 3, 4)})
+        rep = tracing.span_report(evs)
+        assert rep["complete"] == [2, 3]
+        assert rep["truncated"] == [] and rep["bad"] == {}
+        assert rep["interior"] == 2
+
+    def test_prefix_hole_is_truncated_when_ring_wrapped(self):
+        # height 3 lost its Propose+Prevote to eviction: with dropped>0
+        # that is honest ring wrap (oldest-first), NOT a failure — the
+        # fix for `trace --check` being useless on busy nets
+        spec = {h: list(tracing.REQUIRED_STEPS) for h in (1, 2, 4, 5)}
+        spec[3] = list(tracing.REQUIRED_STEPS[2:])
+        evs = self._events(spec)
+        rep = tracing.span_report(evs, dropped=17)
+        assert rep["truncated"] == [3]
+        assert rep["bad"] == {}
+        assert rep["complete"] == [2, 4]
+        # a `since` watermark truncates the same way (dump streamed fresh)
+        rep = tracing.span_report(evs, since=5)
+        assert rep["truncated"] == [3] and rep["bad"] == {}
+
+    def test_prefix_hole_without_wrap_is_a_failure(self):
+        spec = {h: list(tracing.REQUIRED_STEPS) for h in (1, 2, 4)}
+        spec[3] = list(tracing.REQUIRED_STEPS[1:])
+        rep = tracing.span_report(self._events(spec), dropped=0)
+        assert rep["bad"] == {3: [tracing.REQUIRED_STEPS[0]]}
+        assert rep["truncated"] == []
+
+    def test_mid_chain_hole_is_a_failure_even_wrapped(self):
+        # a LATER step present while an earlier one is missing cannot be
+        # oldest-first eviction — real instrumentation/consensus bug
+        spec = {h: list(tracing.REQUIRED_STEPS) for h in (1, 2, 4)}
+        spec[3] = [s for s in tracing.REQUIRED_STEPS if s != "Precommit"]
+        rep = tracing.span_report(self._events(spec), dropped=999)
+        assert rep["bad"] == {3: ["Precommit"]}
+
+    def test_edge_heights_excluded(self):
+        evs = self._events({1: ["Commit"], 2: list(tracing.REQUIRED_STEPS), 3: ["Propose"]})
+        rep = tracing.span_report(evs)
+        assert rep["complete"] == [2] and rep["interior"] == 1
+
+
 class TestSpanChains:
     def _chain_events(self, heights, skip=()):
         r = FlightRecorder(size=1024)
@@ -100,6 +229,34 @@ class TestRPCRoute:
         # seq watermark polling: nothing new -> empty
         again = await core.call("dump_flight_recorder", {"since": snap["next_seq"]})
         assert again["events"] == []
+
+    async def test_route_kinds_filter_anchor_and_moniker(self):
+        from tendermint_tpu.rpc.core import RPCCore
+
+        class _Base:
+            moniker = "trace-node"
+
+        class _Cfg:
+            base = _Base()
+
+        class _StubNode:
+            flight_recorder = FlightRecorder(size=32)
+            config = _Cfg()
+
+        node = _StubNode()
+        node.flight_recorder.record("step", height=1, round=0, step="Propose")
+        node.flight_recorder.record("gossip.wakeup", peer="ab")
+        node.flight_recorder.record("commit", height=1, txs=0, block="aa")
+        core = RPCCore(node)
+        # comma-separated string form (what a URL query carries)
+        snap = await core.call("dump_flight_recorder", {"kinds": "step,commit"})
+        assert [e["kind"] for e in snap["events"]] == ["step", "commit"]
+        # list form (programmatic callers)
+        snap = await core.call("dump_flight_recorder", {"kinds": ["gossip."]})
+        assert [e["kind"] for e in snap["events"]] == ["gossip.wakeup"]
+        # the cross-node alignment surface: anchor + node label
+        assert set(snap["anchor"]) == {"mono_ns", "wall_ns"}
+        assert snap["node"] == "trace-node"
 
     async def test_route_survives_node_without_recorder(self):
         from tendermint_tpu.rpc.core import RPCCore
