@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench bench-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke scale-smoke localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke scale-smoke bls-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -38,6 +38,10 @@ chaos-smoke:     ## scripted partition/kill/twin scenario on a 4-val localnet; f
 
 scale-smoke:     ## 100-validator in-proc net (engine ON, relay gossip): >=10 consecutive commits + partition/heal invariants
 	$(PY) networks/local/scale_smoke.py --json
+
+bls-smoke:       ## BLS12-381 localnet: every stored commit must be ONE aggregate signature + bitmap; empty joiner fastsyncs over them
+	$(PY) networks/local/bls_smoke.py --json
+	rm -rf build-bls
 
 localnet:        ## 4-validator net as OS processes (no docker)
 	$(PY) -m tendermint_tpu.cli testnet --validators 4 --output ./build

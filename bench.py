@@ -546,6 +546,90 @@ def bench_multisig():
     return (time.perf_counter() - t0) / n * 1000
 
 
+def bench_bls():
+    """ROADMAP item 2 numbers: a 100-validator BLS aggregate commit is ONE
+    96-byte signature + bitmap verified by ONE pairing check.  Reports
+    `bls_agg_verify_ms` (the single FastAggregateVerify pairing for the
+    whole commit — what lite2/statesync/fastsync pay per block instead of
+    100 verifies), `bls_commit_bytes` vs the classic ed25519 commit at the
+    same N (`bls_commit_shrink_x`, acceptance floor 10×), and the fold
+    cost consensus pays once at commit time."""
+    from tendermint_tpu.crypto.bls import scheme
+    from tendermint_tpu.crypto.bls.keys import BlsPrivKey
+    from tendermint_tpu.types import (
+        BlockID,
+        MockPV,
+        PartSetHeader,
+        Validator,
+        ValidatorSet,
+        Vote,
+        VoteSet,
+    )
+    from tendermint_tpu.types.agg_commit import fold_commit
+    from tendermint_tpu.types.canonical import PRECOMMIT_TYPE
+
+    n_vals = 100
+
+    def full_commit(pvs):
+        vset = ValidatorSet([Validator.new(pv.get_pub_key(), 10) for pv in pvs])
+        bid = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+        vs = VoteSet("bench-chain", 5, 0, PRECOMMIT_TYPE, vset)
+        for pv in pvs:
+            i, _ = vset.get_by_address(pv.address())
+            v = Vote(type=PRECOMMIT_TYPE, height=5, round=0, block_id=bid,
+                     timestamp_ns=i + 1, validator_address=pv.address(),
+                     validator_index=i)
+            pv.sign_vote("bench-chain", v)
+            vs.add_vote(v)
+        return vset, vs.make_commit()
+
+    bls_pvs = sorted(
+        [MockPV(priv_key=BlsPrivKey.from_secret(b"bls-bench-%d" % i))
+         for i in range(n_vals)],
+        key=lambda pv: pv.address(),
+    )
+    vset, commit = full_commit(bls_pvs)
+    t0 = time.perf_counter()
+    agg = fold_commit(commit, vset, "bench-chain")
+    fold_ms = (time.perf_counter() - t0) * 1000
+    assert agg is not None and agg.signers.count() == n_vals
+
+    pks = [v.pub_key.bytes() for v in vset.validators]
+    msg = agg.sign_message("bench-chain")
+    assert scheme.fast_aggregate_verify(pks, msg, agg.agg_sig)  # warmup
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        assert scheme.fast_aggregate_verify(pks, msg, agg.agg_sig)
+        times.append(time.perf_counter() - t0)
+    verify_ms = min(times) * 1000
+
+    ed_pvs = sorted([MockPV() for _ in range(n_vals)], key=lambda pv: pv.address())
+    _, ed_commit = full_commit(ed_pvs)
+    bls_bytes = len(agg.encode())
+    # classic commit canonical bytes: same proto layout AggregateCommit.encode
+    # uses, with one CommitSig record per validator slot
+    from tendermint_tpu.encoding.proto import field_bytes, field_varint
+
+    ed_bytes = len(
+        field_varint(1, ed_commit.height)
+        + field_varint(2, ed_commit.round)
+        + field_bytes(3, ed_commit.block_id.encode())
+        + b"".join(field_bytes(4, cs.encode()) for cs in ed_commit.signatures)
+    )
+    shrink = ed_bytes / bls_bytes
+    assert shrink >= 10.0, (
+        f"aggregate commit only {shrink:.1f}x smaller than ed25519 at N={n_vals}"
+    )
+    return {
+        "bls_agg_verify_ms": round(verify_ms, 2),
+        "bls_commit_bytes": bls_bytes,
+        "ed25519_commit_bytes_100val": ed_bytes,
+        "bls_commit_shrink_x": round(shrink, 1),
+        "bls_fold_ms": round(fold_ms, 2),
+    }
+
+
 async def bench_lite2():
     """BASELINE #4: bisection sync to height 20 of a 100-validator chain
     (every hop = batched commit verifications on the engine)."""
@@ -692,6 +776,10 @@ def main() -> None:
         "sr25519_verify_ms": bench_sr25519(),
         "multisig_7of10_verify_ms": bench_multisig(),
     }
+    try:
+        bls = bench_bls()
+    except Exception as e:
+        bls = {"bls_agg_verify_ms": -1.0, "error": str(e)[:300]}
     out = {
         "metric": "batched_ed25519_sigs_per_sec_per_chip",
         "value": round(primary["sigs_per_sec"], 1),
@@ -735,6 +823,7 @@ def main() -> None:
             "chaos_partition_recovery_ms_100val"
         ),
         "vote_hop_flush_ms": round(hop_ms, 3),
+        **bls,
         "e2e_4val_recorder": procs.get("recorder"),
         "e2e_4val_breakdown": _e2e_breakdown(procs, hop_ms),
         **{k: round(v, 2) for k, v in extras.items()},

@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""BLS aggregate-commit smoke: a BLS12-381 localnet must commit blocks
+whose stored commits carry ONE aggregate signature + signer bitmap — the
+`make bls-smoke` acceptance rig for the crypto/bls subsystem.
+
+Flow:
+  1. generate a 3-validator `testnet --fast --key-type bls12381` tree
+     (BLS keys everywhere, genesis validators carry proofs of possession);
+  2. run the validators as OS processes until ≥ --min-heights blocks
+     commit;
+  3. fetch every canonical commit below the tip from EVERY node's
+     `/commit` RPC and require the aggregate representation: a 96-byte
+     `agg_sig` + `signers` bitmap with ≥ 2/3 of the set, and NO per-vote
+     `signatures` array — one classic commit anywhere fails the smoke
+     (aggregation silently disabled is exactly the regression this rig
+     exists to catch);
+  4. spawn a 4th EMPTY non-validator node that fastsyncs from genesis —
+     its replay verifies the same aggregate commits through
+     `fastsync.processor.verify_commit_run`'s one-pairing batch — and
+     require it to catch up within the budget.
+
+With --json the last stdout line carries `bls_commit_bytes` (measured
+canonical commit size) and `commits_per_sec` — the numbers bench.py
+reports next to the ed25519 baseline.
+"""
+
+import argparse
+import base64
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+from tendermint_tpu.config import load_config, save_config  # noqa: E402
+
+BLS_SIG_LEN = 96
+
+
+def rpc(port: int, path: str):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/{path}", timeout=3) as r:
+        return json.load(r)
+
+
+def heights(ports):
+    out = []
+    for p in ports:
+        try:
+            out.append(int(rpc(p, "status")["result"]["sync_info"]["latest_block_height"]))
+        except Exception:
+            out.append(-1)
+    return out
+
+
+def spawn(home: str, env) -> subprocess.Popen:
+    log = open(os.path.join(home, "node.log"), "wb")
+    return subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home, "node"],
+        env=env, stdout=log, stderr=subprocess.STDOUT,
+    )
+
+
+def rpc_port_of(home: str) -> int:
+    cfg = load_config(os.path.join(home, "config", "config.toml"), home=home)
+    return int(cfg.rpc.laddr.rsplit(":", 1)[1])
+
+
+def check_commit(commit: dict, n_vals: int) -> int:
+    """Assert one commit dict is the aggregate representation; returns its
+    canonical byte size (bitmap + agg_sig + ids)."""
+    if "signatures" in commit:
+        raise AssertionError(
+            f"commit at height {commit.get('height')} carries per-vote "
+            "signatures — aggregation did not engage"
+        )
+    sig = commit.get("agg_sig")
+    if isinstance(sig, dict):  # jsonable bytes: {"@b": base64}
+        sig = base64.b64decode(sig["@b"])
+    if not sig or len(sig) != BLS_SIG_LEN:
+        raise AssertionError(f"bad agg_sig in commit: {commit}")
+    signers = commit.get("signers")
+    if isinstance(signers, dict):
+        signers = base64.b64decode(signers["@b"])
+    if not signers:
+        raise AssertionError(f"missing signer bitmap in commit: {commit}")
+    # BitArray wire layout: 4-byte big-endian bit count + bit bytes
+    nbits = int.from_bytes(signers[:4], "big")
+    popcount = sum(bin(b).count("1") for b in signers[4:])
+    if nbits != n_vals or popcount * 3 <= n_vals * 2:
+        raise AssertionError(
+            f"signer bitmap {popcount}/{nbits} below +2/3 of {n_vals}"
+        )
+    # canonical size: what AggregateCommit.encode() measures — block id
+    # (~75B) + bitmap + one 96B signature, O(1) in validator count
+    bid = commit["block_id"]
+    bid_hash = base64.b64decode(bid["hash"]["@b"])
+    psh_hash = base64.b64decode(bid["parts"]["hash"]["@b"])
+    return len(sig) + len(signers) + len(bid_hash) + len(psh_hash) + 24
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--build-dir", default="./build-bls")
+    ap.add_argument("--validators", type=int, default=3)
+    ap.add_argument("--base-port", type=int, default=30656)
+    ap.add_argument("--min-heights", type=int, default=5)
+    ap.add_argument("--budget", type=float, default=240.0,
+                    help="seconds for startup + min-heights commits + joiner catchup")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    build = os.path.abspath(args.build_dir)
+    if os.path.isdir(build):
+        shutil.rmtree(build)
+    n = args.validators
+    rc = subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cli", "testnet",
+         "-v", str(n), "-o", build, "--fast", "--key-type", "bls12381",
+         "--base-port", str(args.base_port)],
+    ).returncode
+    if rc != 0:
+        print("testnet generation failed", file=sys.stderr)
+        return 1
+
+    homes = [os.path.join(build, f"node{i}") for i in range(n)]
+    ports = [rpc_port_of(h) for h in homes]
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [spawn(h, env) for h in homes]
+    joiner_proc = None
+    ok = False
+    result = {}
+    deadline = time.time() + args.budget
+    try:
+        # ---- phase 1: the BLS net must commit blocks --------------------
+        t0 = time.time()
+        while time.time() < deadline:
+            hs = heights(ports)
+            if min(hs) >= args.min_heights:
+                break
+            if any(p.poll() is not None for p in procs):
+                print("a validator process exited", file=sys.stderr)
+                return 1
+            time.sleep(1.0)
+        else:
+            print(f"budget exhausted before {args.min_heights} commits: "
+                  f"{heights(ports)}", file=sys.stderr)
+            return 1
+        elapsed = time.time() - t0
+        hs = heights(ports)
+        print(f"BLS net at heights {hs} after {elapsed:.1f}s")
+
+        # ---- phase 2: every canonical commit must be aggregate ----------
+        sizes = []
+        checked = 0
+        for port in ports:
+            tip = int(rpc(port, "status")["result"]["sync_info"]["latest_block_height"])
+            for h in range(2, tip):  # canonical commits only (below tip)
+                sh = rpc(port, f"commit?height={h}")["result"]["signed_header"]
+                commit = sh["commit"]
+                sizes.append(check_commit(commit, n))
+                checked += 1
+        if not checked:
+            print("no canonical commits to check", file=sys.stderr)
+            return 1
+        size = max(sizes)
+        print(f"checked {checked} stored commits across {n} nodes: all "
+              f"aggregate (ONE {BLS_SIG_LEN}B signature + bitmap, "
+              f"~{size}B canonical)")
+
+        # ---- phase 3: empty joiner fastsyncs over aggregate commits -----
+        joiner = os.path.join(build, "joiner")
+        jport = args.base_port + 10 * n + 1
+        rc = subprocess.run(
+            [sys.executable, "-m", "tendermint_tpu.cli", "--home", joiner, "init",
+             "--chain-id", "ignored"],
+            stdout=subprocess.DEVNULL,
+        ).returncode
+        if rc != 0:
+            print("joiner init failed", file=sys.stderr)
+            return 1
+        # the joiner shares the net's genesis (and so its PoP-checked BLS
+        # validator set) but holds no validator key of its own
+        shutil.copy(os.path.join(homes[0], "config", "genesis.json"),
+                    os.path.join(joiner, "config", "genesis.json"))
+        jcfg = load_config(os.path.join(joiner, "config", "config.toml"), home=joiner)
+        src = load_config(os.path.join(homes[0], "config", "config.toml"), home=homes[0])
+        jcfg.base.chain_id = src.base.chain_id
+        jcfg.base.fast_sync = True
+        jcfg.base.db_backend = "memdb"
+        jcfg.tpu.enabled = False
+        jcfg.p2p.laddr = f"tcp://127.0.0.1:{jport - 1}"
+        jcfg.rpc.laddr = f"tcp://127.0.0.1:{jport}"
+        jcfg.p2p.persistent_peers = src.p2p.persistent_peers
+        jcfg.p2p.allow_duplicate_ip = True
+        save_config(jcfg, os.path.join(joiner, "config", "config.toml"))
+        joiner_proc = spawn(joiner, env)
+        target = min(heights(ports))
+        while time.time() < deadline:
+            jh = heights([jport])[0]
+            if jh >= target:
+                break
+            if joiner_proc.poll() is not None:
+                print("joiner process exited", file=sys.stderr)
+                return 1
+            time.sleep(1.0)
+        else:
+            print(f"joiner stuck at {heights([jport])[0]} (target {target}): "
+                  "fastsync over aggregate commits failed", file=sys.stderr)
+            return 1
+        print(f"joiner fastsynced to height {heights([jport])[0]} "
+              f"(target {target}) — aggregate commits replayed")
+
+        result = {
+            "bls_commit_bytes": size,
+            "bls_commits_checked": checked,
+            "commits_per_sec": round(min(hs) / elapsed, 3),
+            "heights": hs,
+            "validators": n,
+        }
+        ok = True
+    finally:
+        for p in procs + ([joiner_proc] if joiner_proc else []):
+            p.send_signal(signal.SIGTERM)
+        for p in procs + ([joiner_proc] if joiner_proc else []):
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    if args.json and result:
+        print(json.dumps(result))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

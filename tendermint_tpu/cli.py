@@ -21,6 +21,7 @@ import sys
 import time
 
 from .config import Config, load_config, save_config
+from .crypto.keys import KEY_TYPES
 from .types import GenesisDoc, GenesisValidator
 
 
@@ -47,6 +48,7 @@ def cmd_init(args) -> int:
 
     cfg = Config(home=args.home)
     cfg.base.chain_id = args.chain_id or f"test-chain-{os.urandom(3).hex()}"
+    cfg.base.key_type = getattr(args, "key_type", "ed25519") or "ed25519"
     _write_cfg(cfg)
     pv = load_or_gen_file_pv(cfg)
     NodeKey.load_or_gen(cfg.node_key_file())
@@ -55,7 +57,9 @@ def cmd_init(args) -> int:
         gen = GenesisDoc(
             chain_id=cfg.base.chain_id,
             genesis_time_ns=time.time_ns(),
-            validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10)],
+            validators=[
+                GenesisValidator(pv.address(), pv.get_pub_key(), 10, pop=_pv_pop(pv))
+            ],
         )
         gen.save_as(gen_file)
     print(f"Initialized node in {cfg.home} (chain_id={cfg.base.chain_id})")
@@ -119,6 +123,16 @@ def _testnet_peer_indices(i: int, n: int):
     return sorted({(i + off) % n for off in offsets} - {i})
 
 
+def _pv_pop(pv) -> bytes:
+    """Proof of possession for a FilePV's consensus key — non-empty only
+    for BLS12-381 keys (genesis PoP enforcement requires it; other
+    schemes don't carry one)."""
+    priv = getattr(getattr(pv, "key", None), "priv_key", None)
+    if priv is not None and hasattr(priv, "pop"):
+        return priv.pop()
+    return b""
+
+
 def cmd_testnet(args) -> int:
     """commands/testnet.go — an N-validator config tree under --output;
     every node lists every other as a persistent peer (the docker-compose
@@ -144,11 +158,13 @@ def cmd_testnet(args) -> int:
     if twin >= n:
         print(f"--twin {twin} out of range for {n} validators", file=sys.stderr)
         return 2
+    key_type = getattr(args, "key_type", "ed25519") or "ed25519"
     homes, pvs, node_keys = [], [], []
     for i in range(n):
         home = os.path.join(out, f"node{i}")
         cfg = Config(home=home)
         cfg.base.chain_id = chain_id
+        cfg.base.key_type = key_type
         cfg.ensure_dirs()
         pvs.append(load_or_gen_file_pv(cfg))
         node_keys.append(NodeKey.load_or_gen(cfg.node_key_file()))
@@ -162,7 +178,10 @@ def cmd_testnet(args) -> int:
     genesis = GenesisDoc(
         chain_id=chain_id,
         genesis_time_ns=time.time_ns(),
-        validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10) for pv in pvs],
+        validators=[
+            GenesisValidator(pv.address(), pv.get_pub_key(), 10, pop=_pv_pop(pv))
+            for pv in pvs
+        ],
         consensus_params=consensus_params,
     )
     base_port = args.base_port
@@ -170,6 +189,7 @@ def cmd_testnet(args) -> int:
     for i, home in enumerate(homes):
         cfg = Config(home=home)
         cfg.base.chain_id = chain_id
+        cfg.base.key_type = key_type
         cfg.base.moniker = f"node{i}"
         if docker:
             # networks/local topology: fixed container IPs, standard ports
@@ -209,6 +229,18 @@ def cmd_testnet(args) -> int:
             cfg.consensus.timeout_prevote_delta = 0.002
             cfg.consensus.timeout_precommit = 0.02
             cfg.consensus.timeout_precommit_delta = 0.002
+            if key_type == "bls12381":
+                # BLS timing model: every reference-tier verify is one
+                # ~120 ms pairing, so a proposal costs more wall time to
+                # CHECK than the ed25519-grade 100 ms propose timeout —
+                # receivers prevote nil before the proposal lands and the
+                # net churns rounds forever (measured: H=1 R=14+ with all
+                # prevotes split proposal-vs-nil).  Timeouts sit above
+                # pairing latency; skip_timeout_commit still makes commit
+                # turnaround instant once the aggregate forms.
+                cfg.consensus.timeout_propose = 2.0
+                cfg.consensus.timeout_prevote = 0.5
+                cfg.consensus.timeout_precommit = 0.5
             cfg.consensus.timeout_commit = 0.0
             cfg.consensus.skip_timeout_commit = True
             cfg.consensus.peer_gossip_sleep_duration = 0.005
@@ -510,6 +542,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("init", help="initialize a home directory")
     sp.add_argument("--chain-id", default="")
+    sp.add_argument(
+        "--key-type", choices=list(KEY_TYPES), default="ed25519",
+        help="consensus key scheme for the generated priv_validator key "
+        "(bls12381 unlocks aggregate commits)",
+    )
     sp.set_defaults(fn=cmd_init)
 
     sp = sub.add_parser("node", aliases=["run", "start"], help="run a node")
@@ -546,6 +583,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument(
         "--twin", type=int, default=-1,
         help="node index to run as a double-signing twin (requires --chaos)",
+    )
+    sp.add_argument(
+        "--key-type", choices=list(KEY_TYPES), default="ed25519",
+        help="consensus key scheme for every generated validator key; "
+        "bls12381 genesis validators carry proofs of possession and the "
+        "net commits blocks with ONE aggregate signature per commit",
     )
     sp.set_defaults(fn=cmd_testnet)
 

@@ -30,6 +30,11 @@ class BaseConfig:
     node_key_file: str = "config/node_key.json"
     filter_peers: bool = False
     prof_laddr: str = ""
+    # consensus key scheme for a GENERATED priv_validator_key (ed25519 |
+    # sr25519 | bls12381 | secp256k1); existing key files keep whatever
+    # type they carry.  bls12381 unlocks aggregate commits (see
+    # [consensus] bls_aggregate_commits).
+    key_type: str = "ed25519"
 
 
 @dataclass
@@ -192,6 +197,13 @@ class ConsensusConfig:
     # header time is further than this past local now — the node-side twin
     # of lite2's max_clock_drift (defaultMaxClockDrift, 10 s).  0 disables.
     proposal_clock_drift: float = 10.0
+    # BLS aggregate commits (crypto/bls, ROADMAP item 2): when the
+    # validator set is uniformly BLS12-381, commit assembly folds the +2/3
+    # precommits into ONE aggregate signature + signer bitmap, and every
+    # commit consumer verifies it with a single pairing check.  The gate
+    # is automatic — mixed or non-BLS sets keep per-vote commits — so the
+    # knob exists only to A/B the wire format on an all-BLS net.
+    bls_aggregate_commits: bool = True
 
     def propose(self, round_: int) -> float:
         """config.go:815 — base + delta·round."""
@@ -227,6 +239,12 @@ class TPUConfig:
     max_batch: int = 4096
     mesh_devices: int = 0  # 0 = single device; N>1 shards the batch axis
     min_device_batch: int = 16  # below this, serial host verify wins
+    # Route BLS multi-point aggregation (Σpk / Σsig of aggregate commits)
+    # through the batched JAX tier (crypto/bls/jax_tier).  OFF by default:
+    # on CPU-only hosts the pure-python fold wins below committee scale
+    # (measured ~5 ms vs ~200 ms warm + a multi-second compile at N=100 on
+    # a 2-core container); flip on for real device meshes.
+    bls_jax_aggregation: bool = False
 
 
 @dataclass
@@ -334,6 +352,12 @@ class Config:
         """config.go:855."""
         if self.base.db_backend not in ("sqlite", "memdb"):
             raise ValueError(f"unknown db_backend {self.base.db_backend!r}")
+        from .crypto.keys import KEY_TYPES
+
+        if self.base.key_type not in KEY_TYPES:
+            raise ValueError(
+                f"unknown base.key_type {self.base.key_type!r} (want one of {KEY_TYPES})"
+            )
         for name, v in (
             ("timeout_propose", self.consensus.timeout_propose),
             ("timeout_prevote", self.consensus.timeout_prevote),

@@ -65,6 +65,7 @@ from ..libs.log import get_logger
 from ..p2p import ChannelDescriptor, Reactor
 from ..p2p.node_info import GOSSIP_BATCH_VERSION, GOSSIP_SUMMARY_VERSION
 from ..types import BlockID, Proposal, Vote
+from ..types.agg_commit import AggregateCommit, AggregateLastCommit
 from ..types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
 from ..types.part_set import Part
 from .state import ConsensusState
@@ -132,6 +133,11 @@ class PeerRoundState:
         # send, monotonic send time).  Re-sent when our set grew (laggards
         # can pull the new votes) or after expiry (lost-frame repair).
         self.summary_sent: Dict[tuple, Tuple[int, float]] = {}
+        # aggregate-commit catchup dedupe: (height last shipped, monotonic
+        # send time).  A folded height has no per-vote precommits to
+        # gossip, so catchup ships the stored AggregateCommit once per
+        # stuck height, re-sent on a coarse timer (lost-frame repair).
+        self.agg_commit_sent: Tuple[int, float] = (0, 0.0)
 
     # -- updates from peer messages ---------------------------------------
     def apply_new_round_step(self, msg: dict) -> None:
@@ -552,6 +558,17 @@ class ConsensusReactor(Reactor):
                 await self.cs.add_vote_input(vote, peer.id, verified=True)
             elif kind == "vote_batch":
                 await self._receive_vote_batch(peer, ps, msg)
+            elif kind == "agg_commit":
+                try:
+                    commit = AggregateCommit.from_dict(msg["commit"])
+                    commit.validate_basic()
+                except Exception as e:
+                    await self.switch.stop_peer_for_error(peer, f"invalid agg_commit: {e}")
+                    return
+                # signature verification (one pairing) happens inside the
+                # consensus routine against OUR validator set; a forged
+                # commit is dropped there
+                await self.cs.add_agg_commit_input(commit, peer.id)
         elif chan_id == VOTE_SET_BITS_CHANNEL:
             if kind == "vote_set_bits":
                 our_votes = None
@@ -900,7 +917,9 @@ class ConsensusReactor(Reactor):
         addr, val = val_set.get_by_index(vote.validator_index)
         if val is None or addr != vote.validator_address:
             return False
-        return val.pub_key, vote.sign_bytes(self.cs.sm_state.chain_id)
+        # per-scheme sign-bytes: BLS validators sign the timestamp-free
+        # aggregation domain, everyone else the reference layout
+        return val.pub_key, vote.sign_bytes_for_key(self.cs.sm_state.chain_id, val.pub_key)
 
     @staticmethod
     def _engine_key(pub_key) -> Optional[bytes]:
@@ -1126,10 +1145,17 @@ class ConsensusReactor(Reactor):
             if rs.height == ps.height:
                 sent = await self._gossip_votes_for_height(peer, ps, repair)
             elif rs.height == ps.height + 1 and rs.last_commit is not None:
-                sent = await self._send_votes(peer, ps, rs.last_commit)
+                if isinstance(rs.last_commit, AggregateLastCommit):
+                    # restart adapter: the folded seen-commit has no votes
+                    # to stream — ship the aggregate itself
+                    sent = await self._send_agg_commit(peer, ps, rs.last_commit.commit)
+                else:
+                    sent = await self._send_votes(peer, ps, rs.last_commit)
             elif rs.height >= ps.height + 2 and ps.height >= self.cs.block_store.base():
                 commit = self.cs.block_store.load_block_commit(ps.height)
-                if commit is not None:
+                if isinstance(commit, AggregateCommit):
+                    sent = await self._send_agg_commit(peer, ps, commit)
+                elif commit is not None:
                     sent = await self._send_commit_votes(peer, ps, commit)
             relay_on = (
                 debounce > 0
@@ -1183,6 +1209,28 @@ class ConsensusReactor(Reactor):
             if pol is not None and await self._send_votes(peer, ps, pol, relay_ok):
                 return True
         return False
+
+    AGG_COMMIT_RESEND_S = 2.0  # lost-frame repair cadence per stuck peer
+
+    async def _send_agg_commit(self, peer, ps: PeerRoundState, commit) -> bool:
+        """Catchup for a folded height: the per-vote precommits were
+        discarded at fold time, so ship the stored AggregateCommit itself
+        — ONE ~190-byte frame; the receiver authenticates it with one
+        pairing check and finalizes directly (state._apply_aggregate_commit).
+        Deduped per stuck height with a coarse resend timer."""
+        if ps.height != commit.height:
+            return False
+        now = time.monotonic()
+        last_h, last_t = ps.agg_commit_sent
+        if last_h == commit.height and now - last_t < self.AGG_COMMIT_RESEND_S:
+            return False
+        ok = await peer.send(VOTE_CHANNEL, _enc("agg_commit", {"commit": commit.to_dict()}))
+        if ok:
+            ps.agg_commit_sent = (commit.height, now)
+            self.cs.recorder.record(
+                "gossip.agg_commit", height=commit.height, peer=peer.id[:8]
+            )
+        return ok
 
     async def _send_votes(
         self, peer, ps: PeerRoundState, vote_set, relay_ok: bool = True

@@ -98,6 +98,9 @@ class ConsensusState(Service):
         self.priv_validator = None
         self.wal = NilWAL()
         self.do_wal_catchup = True
+        # set only while finalizing from a peer-shipped AggregateCommit;
+        # update_to_state consumes it as the next height's last-commit
+        self._pending_agg_last_commit = None
         self.replay_mode = False
         from ..libs import tracing
         from ..libs.metrics import ConsensusMetrics
@@ -151,7 +154,11 @@ class ConsensusState(Service):
 
     def reconstruct_last_commit_if_needed(self, state: SMState) -> None:
         """consensus/state.go:487 — rebuild LastCommit votes from the
-        stored SeenCommit."""
+        stored SeenCommit.  An aggregate seen-commit has no per-vote
+        signatures to rebuild a VoteSet from: verify its single pairing
+        against the stored set and carry it through an adapter instead
+        (proposal assembly embeds it verbatim; height-1 straggler
+        precommits are ignored — the commit is already +2/3)."""
         if state.last_block_height == 0:
             return
         seen_commit = self.block_store.load_seen_commit(state.last_block_height)
@@ -160,6 +167,14 @@ class ConsensusState(Service):
                 f"failed to reconstruct last commit: seen commit for height "
                 f"{state.last_block_height} not found"
             )
+        from ..types import AggregateCommit, AggregateLastCommit
+
+        if isinstance(seen_commit, AggregateCommit):
+            state.last_validators.verify_commit(
+                state.chain_id, seen_commit.block_id, state.last_block_height, seen_commit
+            )
+            self.rs.last_commit = AggregateLastCommit(seen_commit)
+            return
         last_precommits = commit_to_vote_set(state.chain_id, seen_commit, state.last_validators)
         if not last_precommits.has_two_thirds_majority():
             raise RuntimeError("failed to reconstruct last commit: does not have +2/3 maj")
@@ -225,6 +240,13 @@ class ConsensusState(Service):
 
     async def set_proposal_input(self, proposal: Proposal, peer_id: str = "") -> None:
         await self.msg_queue.put({"type": "proposal", "proposal": proposal, "peer_id": peer_id})
+
+    async def add_agg_commit_input(self, commit, peer_id: str = "") -> None:
+        """Catchup fast-path for aggregate-commit nets: a peer ≥2 heights
+        ahead has no per-vote precommits to serve for a folded height, so
+        it ships the stored AggregateCommit itself (reactor `agg_commit`
+        message); ONE pairing check replaces the vote tally."""
+        await self.msg_queue.put({"type": "agg_commit", "commit": commit, "peer_id": peer_id})
 
     async def add_block_part_input(
         self, height: int, round_: int, part: Part, peer_id: str = ""
@@ -345,6 +367,8 @@ class ConsensusState(Service):
                         cb(self.rs)
             elif kind == "vote":
                 await self._try_add_vote(mi["vote"], peer_id, mi.get("verified", False))
+            elif kind == "agg_commit":
+                await self._apply_aggregate_commit(mi["commit"], peer_id)
         except ErrVoteConflictingVotes:
             raise  # own double-sign — _try_add_vote re-raises only then; halt
         except (VoteError, PartSetError, InvalidProposalSignatureError,
@@ -517,7 +541,9 @@ class ConsensusState(Service):
         if rs.height == 1:
             commit = Commit(0, 0, BlockID(), [])
         elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
-            commit = rs.last_commit.make_commit()
+            commit = self._maybe_fold_commit(
+                rs.last_commit.make_commit(), self.sm_state.last_validators
+            )
         else:
             self.log.error("cannot propose: no commit for the previous block")
             return None
@@ -527,6 +553,27 @@ class ConsensusState(Service):
         )
         parts = block.make_part_set(BLOCK_PART_SIZE_BYTES)
         return block, parts
+
+    def _maybe_fold_commit(self, commit, val_set):
+        """Fold a +2/3 commit into ONE aggregate BLS signature + signer
+        bitmap when the signing set is uniformly BLS (types/agg_commit).
+        Ineligible commits (mixed/non-BLS sets, or one already folded by a
+        restart adapter) pass through untouched — aggregation disables
+        itself, per-scheme routing still verifies them."""
+        if not getattr(self.config, "bls_aggregate_commits", True):
+            return commit
+        from ..types import fold_commit
+
+        folded = fold_commit(commit, val_set, self.sm_state.chain_id)
+        if folded is None:
+            return commit
+        self.recorder.record(
+            "commit.aggregate",
+            height=folded.height,
+            signers=folded.signers.count(),
+            bytes=len(folded.encode()),
+        )
+        return folded
 
     def _is_proposal_complete(self) -> bool:
         """state.go:1000."""
@@ -736,9 +783,92 @@ class ConsensusState(Service):
         if rs.height != height or rs.step != RoundStep.COMMIT:
             return
         block_id, ok = rs.votes.precommits(rs.commit_round).two_thirds_majority()
-        block, block_parts = rs.proposal_block, rs.proposal_block_parts
         if not ok:
             raise RuntimeError("cannot finalize commit: no +2/3 majority")
+        await self._finalize_block(
+            block_id,
+            lambda: self._maybe_fold_commit(
+                rs.votes.precommits(rs.commit_round).make_commit(), rs.validators
+            ),
+        )
+
+    async def _apply_aggregate_commit(self, commit, peer_id: str = "") -> None:
+        """Commit this height from a peer-shipped AggregateCommit — the
+        catchup lane for folded heights (per-vote precommits no longer
+        exist anywhere, so the normal vote-tally path can never fire).
+        One pairing check against OUR validator set authenticates it; the
+        block either is already in hand or the part-set is retargeted so
+        catchup block parts flow, with the verified commit parked on
+        rs.catchup_agg_commit for the completion hook."""
+        rs = self.rs
+        if commit.height != rs.height or rs.validators is None:
+            return
+        if self.block_store.height() >= commit.height:
+            return  # already committed; duplicate catchup frame
+        from ..types.validator import NotEnoughVotingPowerError
+
+        try:
+            commit.validate_basic()
+            # one pairing + (+2/3)-power tally; memoized scheme-side so a
+            # resent frame costs a dict lookup
+            rs.validators.verify_commit(
+                self.sm_state.chain_id, commit.block_id, commit.height, commit
+            )
+        except (ValueError, NotEnoughVotingPowerError) as e:
+            # NotEnoughVotingPowerError is NOT a ValueError: a peer
+            # aggregating a genuine-but-minority signer subset (valid
+            # pairing, sub-2/3 power) must be dropped here, not escape to
+            # the receive loop as a consensus failure
+            self.log.debug("invalid agg_commit from peer", peer=peer_id, err=str(e))
+            return
+        self.recorder.record(
+            "commit.agg_catchup", height=commit.height,
+            src=peer_id[:8] if peer_id else "self",
+        )
+        if rs.locked_block is not None and rs.locked_block.hashes_to(commit.block_id.hash):
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if rs.proposal_block is not None and rs.proposal_block.hashes_to(commit.block_id.hash):
+            await self._finalize_from_aggregate(commit)
+            return
+        # block not in hand: retarget the part set (enter_commit's
+        # unknown-block shape) and let the data-gossip catchup fill it
+        rs.catchup_agg_commit = commit
+        if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+            commit.block_id.parts_header
+        ):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet.from_header(commit.block_id.parts_header)
+            if self.event_bus:
+                await self.event_bus.publish_valid_block(rs.event_dict())
+            for cb in self.on_valid_block:
+                cb(rs)
+
+    async def _finalize_from_aggregate(self, commit) -> None:
+        from ..types import AggregateLastCommit
+
+        rs = self.rs
+        rs.catchup_agg_commit = None
+        rs.commit_round = max(commit.round, 0)
+        self._update_round_step(rs.round, RoundStep.COMMIT)
+        rs.commit_time = self.clock.monotonic()
+        await self._new_step()
+        # update_to_state (inside _finalize_block) must NOT look for +2/3
+        # in the precommit vote set — the commit's votes never existed
+        # here; carry the verified aggregate as the next height's
+        # last-commit adapter instead
+        self._pending_agg_last_commit = AggregateLastCommit(commit)
+        try:
+            await self._finalize_block(commit.block_id, lambda: commit)
+        finally:
+            self._pending_agg_last_commit = None
+
+    async def _finalize_block(self, block_id, seen_commit_fn) -> None:
+        """The source-independent tail of finalize_commit: `block_id` and
+        the lazily-built seen commit come from either the precommit vote
+        set (normal path) or a verified AggregateCommit (catchup path)."""
+        rs = self.rs
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
         if not block_parts.has_header(block_id.parts_header):
             raise RuntimeError("commit parts header mismatch")
         if not block.hashes_to(block_id.hash):
@@ -754,8 +884,7 @@ class ConsensusState(Service):
         fail_point("finalize-pre-save")
 
         if self.block_store.height() < block.height:
-            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
-            self.block_store.save_block(block, block_parts, seen_commit)
+            self.block_store.save_block(block, block_parts, seen_commit_fn())
         fail_point("finalize-saved-block")
         self.recorder.record(
             "commit", height=block.height, txs=len(block.txs),
@@ -764,7 +893,7 @@ class ConsensusState(Service):
         self._record_metrics(block)
 
         # end-height marker implies the block store has the block (wal.go:46)
-        self.wal.write_end_height(height)
+        self.wal.write_end_height(block.height)
         fail_point("finalize-walled-endheight")
 
         state_copy = self.sm_state.copy()
@@ -912,6 +1041,17 @@ class ConsensusState(Service):
                     rs.valid_round = rs.round
                     rs.valid_block = rs.proposal_block
                     rs.valid_block_parts = rs.proposal_block_parts
+
+            agg = rs.catchup_agg_commit
+            if (
+                agg is not None
+                and agg.height == rs.height
+                and rs.proposal_block.hashes_to(agg.block_id.hash)
+            ):
+                # aggregate-commit catchup: the commit was verified before
+                # the block arrived; finalize now that the block is whole
+                await self._finalize_from_aggregate(agg)
+                return added
 
             if rs.step <= RoundStep.PROPOSE and self._is_proposal_complete():
                 await self.enter_prevote(height, rs.round)
@@ -1118,7 +1258,14 @@ class ConsensusState(Service):
             return
 
         last_precommits = None
-        if rs.commit_round > -1 and rs.votes is not None:
+        pending_agg = getattr(self, "_pending_agg_last_commit", None)
+        if pending_agg is not None and pending_agg.height == state.last_block_height:
+            # aggregate-commit catchup: the committed height's precommits
+            # never existed as votes here — the verified aggregate itself
+            # is the last-commit surface (same adapter the restart
+            # reconstruction uses)
+            last_precommits = pending_agg
+        elif rs.commit_round > -1 and rs.votes is not None:
             pc = rs.votes.precommits(rs.commit_round)
             if pc is None or not pc.has_two_thirds_majority():
                 raise RuntimeError("update_to_state called but last precommit round lacks +2/3")

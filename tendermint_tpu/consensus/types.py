@@ -142,6 +142,11 @@ class RoundState:
     last_commit: Optional[VoteSet] = None
     last_validators: Optional[ValidatorSet] = None
     triggered_timeout_precommit: bool = False
+    # Aggregate-commit catchup (types/agg_commit): a VERIFIED aggregate
+    # commit for this height whose block is still being fetched — the
+    # block-part completion path finalizes from it directly, since folded
+    # commits have no per-vote precommits to drive the normal vote tally.
+    catchup_agg_commit: Optional[object] = None
 
     def event_dict(self) -> dict:
         return {

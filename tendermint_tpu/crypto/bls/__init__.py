@@ -1,0 +1,29 @@
+"""BLS12-381 min-pk signatures: pubkeys in G1 (48B), signatures in G2 (96B).
+
+The subsystem behind aggregate commits (ROADMAP item 2; "Performance of
+EdDSA and BLS Signatures in Committee-Based Consensus", arXiv:2302.00418):
+a +2/3 commit of N precommits folds into ONE 96-byte aggregate signature +
+signer bitmap, verified with a single pairing-product check instead of N
+per-signature verifies.
+
+Two tiers, mirroring the ed25519 stack:
+
+* reference tier (`fields`/`curve`/`pairing`/`hash_to_curve`/`scheme`):
+  pure-Python field towers and pairings — the differential oracle and the
+  dependency-less host path.
+* JAX tier (`jax_tier`): batched Montgomery limb arithmetic for the hot
+  multi-point G1/G2 aggregation (the per-commit Σpk / Σsig sums), riding
+  the same vmap-over-batch design as the ed25519 limb kernels.
+
+Key classes (`BlsPubKey`/`BlsPrivKey`) live in `crypto/bls/keys.py` and
+slot into the polymorphic `crypto.PubKey` verify routing, so ed25519 and
+sr25519 validator sets are untouched.
+"""
+
+from .keys import (  # noqa: F401
+    BlsPrivKey,
+    BlsPubKey,
+    PUBKEY_SIZE,
+    SIGNATURE_SIZE,
+)
+from . import scheme  # noqa: F401

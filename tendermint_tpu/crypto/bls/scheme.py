@@ -1,0 +1,343 @@
+"""BLS min-pk signature scheme (draft-irtf-cfrg-bls-signature shape):
+pubkeys in G1 (48B compressed), signatures in G2 (96B compressed),
+proof-of-possession variant — FastAggregateVerify is only sound for
+PoP-checked key sets, which the validator-set plumbing enforces at
+genesis/valset-update time.
+
+Every verification bottoms out in `pairing.pairing_check` — ONE
+pairing-product with a shared final exponentiation.  `batch_verify_
+aggregates` folds k independent aggregate checks into a single product
+using random blinding scalars (Fiat–Shamir-free batching: a forged item
+survives with probability ~2⁻⁶⁴ per batch; failures fall back to
+per-item checks so the caller still learns WHICH item lied).
+
+A small result memo keyed by (pubkeys-digest, msg, sig) lets async
+pre-verification lanes (statesync/lite2) warm the synchronous
+verify_commit path without re-pairing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import curve, hash_to_curve
+from .fields import R
+
+# Suite DSTs (see hash_to_curve.py header for why SVDW, not SSWU)
+DST_SIG = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SVDW_RO_POP_"
+DST_POP = b"BLS_POP_BLS12381G2_XMD:SHA-256_SVDW_RO_POP_"
+
+PUBKEY_SIZE = 48
+SIGNATURE_SIZE = 96
+
+
+# -- keygen -----------------------------------------------------------------
+
+
+def keygen(ikm: bytes, key_info: bytes = b"") -> int:
+    """HKDF-based KeyGen (draft §2.3): deterministic sk ∈ [1, r-1]."""
+    if len(ikm) < 32:
+        raise ValueError("ikm must be at least 32 bytes")
+    salt = b"BLS-SIG-KEYGEN-SALT-"
+    while True:
+        salt = hashlib.sha256(salt).digest()
+        prk = hmac.new(salt, ikm + b"\x00", hashlib.sha256).digest()
+        okm = b""
+        t = b""
+        info = key_info + (48).to_bytes(2, "big")
+        for i in range(1, 3):
+            t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+            okm += t
+        sk = int.from_bytes(okm[:48], "big") % R
+        if sk != 0:
+            return sk
+
+
+def generate() -> int:
+    return keygen(os.urandom(32))
+
+
+def sk_to_pk(sk: int) -> bytes:
+    return curve.g1_compress(curve.g1_mul(curve.G1_GEN, sk))
+
+
+# -- core sign/verify -------------------------------------------------------
+
+
+# hash_to_g2 memo: consensus verifies many signatures over the SAME
+# message (every precommit for a block signs identical timestamp-free
+# bytes), so the ~15 ms map+clear-cofactor runs once per (msg, dst).
+# Bounded FIFO like the result memo below.
+_H2G_MAX = 256
+_h2g: Dict[Tuple[bytes, bytes], tuple] = {}
+
+
+def hash_to_g2_cached(msg: bytes, dst: bytes):
+    key = (bytes(msg), dst)
+    pt = _h2g.get(key)
+    if pt is None:
+        pt = hash_to_curve.hash_to_g2(msg, dst)
+        if len(_h2g) >= _H2G_MAX:
+            for k in list(_h2g)[: _H2G_MAX // 4]:
+                _h2g.pop(k, None)
+        _h2g[key] = pt
+    return pt
+
+
+def sign(sk: int, msg: bytes, dst: bytes = DST_SIG) -> bytes:
+    return curve.g2_compress(curve.g2_mul(hash_to_g2_cached(msg, dst), sk))
+
+
+def _neg_g1_gen():
+    return curve.g1_neg(curve.G1_GEN)
+
+
+def verify(pk: bytes, msg: bytes, sig: bytes, dst: bytes = DST_SIG, pk_point=None) -> bool:
+    """e(pk, H(m)) · e(-g1, sig) == 1.  `pk_point` lets callers holding a
+    cached decompressed (subgroup-checked) pubkey skip the G1 decompress."""
+    pkp = pk_point if pk_point is not None else curve.g1_decompress(pk)
+    sigp = curve.g2_decompress(sig)
+    if pkp is None or sigp is None or curve.g1_is_inf(pkp):
+        return False
+    h = hash_to_g2_cached(msg, dst)
+    return pairing_check_cached(
+        [(pkp, h), (_neg_g1_gen(), sigp)]
+    )
+
+
+def pairing_check_cached(pairs) -> bool:
+    from . import pairing
+
+    return pairing.pairing_check(pairs)
+
+
+# -- aggregation ------------------------------------------------------------
+
+
+def aggregate_signatures(sigs: Sequence[bytes]) -> Optional[bytes]:
+    """Σ sigᵢ in G2; None if any blob is invalid."""
+    pts = []
+    for s in sigs:
+        p = curve.g2_decompress(s)
+        if p is None:
+            return None
+        pts.append(p)
+    if not pts:
+        return None
+    return curve.g2_compress(_sum_g2(pts))
+
+
+def aggregate_pubkeys(pks: Sequence[bytes]) -> Optional[bytes]:
+    """Σ pkᵢ in G1 (the apk of FastAggregateVerify)."""
+    pts = []
+    for pk in pks:
+        p = curve.g1_decompress(pk)
+        if p is None or curve.g1_is_inf(p):
+            return None
+        pts.append(p)
+    if not pts:
+        return None
+    return curve.g1_compress(_sum_g1(pts))
+
+
+def _sum_g1(pts):
+    jt = _jax_aggregator()
+    if jt is not None and len(pts) >= jt.MIN_BATCH:
+        out = jt.aggregate_g1(pts)
+        if out is not None:
+            return out
+    acc = curve.G1_INF
+    for p in pts:
+        acc = curve.g1_add(acc, p)
+    return acc
+
+
+def _sum_g2(pts):
+    jt = _jax_aggregator()
+    if jt is not None and len(pts) >= jt.MIN_BATCH:
+        out = jt.aggregate_g2(pts)
+        if out is not None:
+            return out
+    acc = curve.G2_INF
+    for p in pts:
+        acc = curve.g2_add(acc, p)
+    return acc
+
+
+_jax_agg_enabled = False
+
+
+def set_jax_aggregation(enabled: bool) -> None:
+    """Route multi-point G1/G2 sums through the batched JAX tier (engine
+    nodes turn this on at startup; the pure tier stays the default so a
+    JAX-less host never pays an import)."""
+    global _jax_agg_enabled
+    _jax_agg_enabled = bool(enabled)
+
+
+def _jax_aggregator():
+    if not _jax_agg_enabled:
+        return None
+    try:
+        from . import jax_tier
+
+        return jax_tier if jax_tier.available() else None
+    except Exception:
+        return None
+
+
+def fast_aggregate_verify(
+    pks: Sequence[bytes], msg: bytes, agg_sig: bytes, dst: bytes = DST_SIG
+) -> bool:
+    """All signers signed the SAME msg (PoP-gated).  One pairing check:
+    e(Σpk, H(m)) · e(-g1, σ) == 1."""
+    if not pks:
+        return False
+    apk = aggregate_pubkeys(pks)
+    if apk is None:
+        return False
+    return verify(apk, msg, agg_sig, dst)
+
+
+def aggregate_verify(
+    pks: Sequence[bytes], msgs: Sequence[bytes], agg_sig: bytes, dst: bytes = DST_SIG
+) -> bool:
+    """Distinct messages: Π e(pkᵢ, H(mᵢ)) · e(-g1, σ) == 1.  Messages must
+    be distinct per the PoP-less soundness requirement."""
+    if not pks or len(pks) != len(msgs) or len(set(msgs)) != len(msgs):
+        return False
+    sigp = curve.g2_decompress(agg_sig)
+    if sigp is None:
+        return False
+    pairs = []
+    for pk, m in zip(pks, msgs):
+        pkp = curve.g1_decompress(pk)
+        if pkp is None or curve.g1_is_inf(pkp):
+            return False
+        pairs.append((pkp, hash_to_g2_cached(m, dst)))
+    pairs.append((_neg_g1_gen(), sigp))
+    return pairing_check_cached(pairs)
+
+
+# -- proof of possession ----------------------------------------------------
+
+
+def pop_prove(sk: int) -> bytes:
+    return sign(sk, sk_to_pk(sk), DST_POP)
+
+
+def pop_verify(pk: bytes, proof: bytes) -> bool:
+    return verify(pk, pk, proof, DST_POP)
+
+
+def batch_pop_verify(items: Sequence[Tuple[bytes, bytes]]) -> bool:
+    """All-or-nothing PoP check for a whole validator set in ONE blinded
+    pairing product (per-key fallback is the caller's job on False)."""
+    if not items:
+        return True
+    pairs = []
+    for pk, proof in items:
+        pkp = curve.g1_decompress(pk)
+        prf = curve.g2_decompress(proof)
+        if pkp is None or prf is None or curve.g1_is_inf(pkp):
+            return False
+        rnd = int.from_bytes(os.urandom(8), "big") | 1
+        h = hash_to_g2_cached(pk, DST_POP)
+        pairs.append((curve.g1_mul(pkp, rnd), h))
+        pairs.append((curve.g1_mul(_neg_g1_gen(), rnd), prf))
+    return pairing_check_cached(pairs)
+
+
+# -- batched aggregate checks (the fastsync/statesync fan-in) ---------------
+
+# result memo: (sha256(pk bytes concat), msg, sig) -> bool.  Bounded FIFO;
+# async pre-verify lanes insert, the sync verify_commit path hits.
+_MEMO_MAX = 4096
+_memo: Dict[Tuple[bytes, bytes, bytes], bool] = {}
+
+
+def _memo_key(pks: Sequence[bytes], msg: bytes, sig: bytes):
+    h = hashlib.sha256()
+    for pk in pks:
+        h.update(pk)
+    return (h.digest(), msg, sig)
+
+
+def memo_put(pks: Sequence[bytes], msg: bytes, sig: bytes, ok: bool) -> None:
+    if len(_memo) >= _MEMO_MAX:
+        for k in list(_memo)[: _MEMO_MAX // 4]:
+            _memo.pop(k, None)
+    _memo[_memo_key(pks, msg, sig)] = ok
+
+
+def memo_get(pks: Sequence[bytes], msg: bytes, sig: bytes) -> Optional[bool]:
+    return _memo.get(_memo_key(pks, msg, sig))
+
+
+def batch_verify_aggregates(
+    items: Sequence[Tuple[Sequence[bytes], bytes, bytes]], dst: bytes = DST_SIG
+) -> List[bool]:
+    """items: (pubkeys, msg, agg_sig) triples, each a FastAggregateVerify
+    claim.  One blinded pairing product for the whole batch; on failure,
+    per-item re-checks attribute the liar.  Results are memoized."""
+    out: List[Optional[bool]] = [None] * len(items)
+    todo = []
+    for i, (pks, msg, sig) in enumerate(items):
+        hit = memo_get(pks, msg, sig)
+        if hit is not None:
+            out[i] = hit
+            continue
+        todo.append(i)
+    if todo:
+        pairs = []
+        decoded = {}
+        for i in todo:
+            pks, msg, sig = items[i]
+            apk = aggregate_pubkeys(pks)
+            apkp = curve.g1_decompress(apk) if apk is not None else None
+            sigp = curve.g2_decompress(sig) if apk is not None else None
+            # reject the infinity aggregate pubkey exactly like verify()
+            # does: e(INF, H(m)) == 1 for ANY message, and this lane's
+            # memo feeds the strict synchronous path — the two lanes must
+            # agree on every input
+            if apkp is None or sigp is None or curve.g1_is_inf(apkp):
+                out[i] = False
+                memo_put(pks, msg, sig, False)
+                continue
+            decoded[i] = (apkp, sigp, msg)
+        live = list(decoded)
+        if len(live) == 1:
+            i = live[0]
+            apkp, sigp, msg = decoded[i]
+            ok = pairing_check_cached(
+                [(apkp, hash_to_g2_cached(msg, dst)), (_neg_g1_gen(), sigp)]
+            )
+            out[i] = ok
+            memo_put(*items[i], ok)
+        elif live:
+            for i in live:
+                apkp, sigp, msg = decoded[i]
+                rnd = int.from_bytes(os.urandom(8), "big") | 1
+                pairs.append(
+                    (curve.g1_mul(apkp, rnd), hash_to_g2_cached(msg, dst))
+                )
+                pairs.append((curve.g1_mul(_neg_g1_gen(), rnd), sigp))
+            if pairing_check_cached(pairs):
+                for i in live:
+                    out[i] = True
+                    memo_put(*items[i], True)
+            else:
+                for i in live:
+                    apkp, sigp, msg = decoded[i]
+                    ok = pairing_check_cached(
+                        [
+                            (apkp, hash_to_g2_cached(msg, dst)),
+                            (_neg_g1_gen(), sigp),
+                        ]
+                    )
+                    out[i] = ok
+                    memo_put(*items[i], ok)
+    return [bool(v) for v in out]

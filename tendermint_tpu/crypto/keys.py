@@ -238,8 +238,52 @@ def pubkey_from_dict(d: dict) -> PubKey:
 
     if t == Sr25519PubKey.TYPE:
         return Sr25519PubKey(d["value"])
+    if t == "tendermint/PubKeyBLS12381":
+        from .bls import BlsPubKey  # lazy: the field tower is import-heavy
+
+        return BlsPubKey(d["value"])
     from .multisig import MultisigThresholdPubKey  # cyclic at import time
 
     if t == MultisigThresholdPubKey.TYPE:
         return MultisigThresholdPubKey.from_dict(d)
     raise ValueError(f"unknown pubkey type {t!r}")
+
+
+def privkey_from_dict(d: dict) -> PrivKey:
+    """Route a {"type", "value"} dict to the concrete PrivKey — the
+    privval key-file loader's dispatch (mirrors pubkey_from_dict)."""
+    t = d.get("type")
+    if t == Ed25519PrivKey.TYPE:
+        return Ed25519PrivKey(d["value"])
+    if t == Secp256k1PrivKey.TYPE:
+        return Secp256k1PrivKey(d["value"])
+    from .sr25519 import Sr25519PrivKey
+
+    if t == Sr25519PrivKey.TYPE:
+        return Sr25519PrivKey(d["value"])
+    if t == "tendermint/PrivKeyBLS12381":
+        from .bls import BlsPrivKey
+
+        return BlsPrivKey(d["value"])
+    raise ValueError(f"unknown privkey type {t!r}")
+
+
+# key-type names accepted by `testnet --key-type` / FilePV.generate —
+# mirrors the reference's key-type plumbing (sr25519 rode the same path)
+KEY_TYPES = ("ed25519", "sr25519", "bls12381", "secp256k1")
+
+
+def generate_priv_key(key_type: str = "ed25519") -> PrivKey:
+    if key_type == "ed25519":
+        return Ed25519PrivKey.generate()
+    if key_type == "secp256k1":
+        return Secp256k1PrivKey.generate()
+    if key_type == "sr25519":
+        from .sr25519 import Sr25519PrivKey
+
+        return Sr25519PrivKey.generate()
+    if key_type == "bls12381":
+        from .bls import BlsPrivKey
+
+        return BlsPrivKey.generate()
+    raise ValueError(f"unknown key type {key_type!r} (want one of {KEY_TYPES})")

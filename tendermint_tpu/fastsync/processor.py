@@ -66,12 +66,16 @@ def verify_commit_run(
 
     pairs: (block_id, height, commit) per height.  Returns per-height ok.
     """
+    from ..types.agg_commit import AggregateCommit
+
     idxs: List[Tuple[int, int]] = []  # (pair_idx, sig_idx)
     pubkeys, msgs, sigs = [], [], []
     structural_ok = []
+    agg_items: List[Tuple[int, tuple]] = []  # (pair_idx, claim) — one batch
+    agg_power: dict = {}
     for pi, (block_id, height, commit) in enumerate(pairs):
         try:
-            if val_set.size() != len(commit.signatures):
+            if val_set.size() != commit.size():
                 raise ValueError("commit size mismatch")
             commit.validate_basic()
             if height != commit.height or block_id != commit.block_id:
@@ -80,12 +84,25 @@ def verify_commit_run(
             structural_ok.append(False)
             continue
         structural_ok.append(True)
+        if isinstance(commit, AggregateCommit):
+            # the whole run of aggregate commits becomes ONE blinded
+            # pairing product below (k commits, one final exponentiation)
+            signer_idxs = commit.signers.true_indices()
+            try:
+                pks = [val_set.validators[i].pub_key.bytes() for i in signer_idxs]
+            except IndexError:
+                structural_ok[pi] = False
+                continue
+            agg_items.append((pi, (pks, commit.sign_message(chain_id), commit.agg_sig)))
+            agg_power[pi] = sum(val_set.validators[i].voting_power for i in signer_idxs)
+            continue
         for i, cs in enumerate(commit.signatures):
             if cs.is_absent():
                 continue
             idxs.append((pi, i))
-            pubkeys.append(val_set.validators[i].pub_key)
-            msgs.append(commit.vote_sign_bytes(chain_id, i))
+            pk = val_set.validators[i].pub_key
+            pubkeys.append(pk)
+            msgs.append(commit.vote_sign_bytes(chain_id, i, pub_key=pk))
             sigs.append(cs.signature)
 
     # type-routed: ed25519 rides the batch engine, other key types verify
@@ -104,6 +121,15 @@ def verify_commit_run(
         cs = pairs[pi][2].signatures[i]
         if pairs[pi][0] == cs.block_id(pairs[pi][2].block_id):
             tallied[pi] += val_set.validators[i].voting_power
+    if agg_items:
+        from ..crypto.bls import scheme as _bls_scheme
+
+        agg_ok = _bls_scheme.batch_verify_aggregates([c for _, c in agg_items])
+        for (pi, _), good in zip(agg_items, agg_ok):
+            if not good:
+                sig_ok[pi] = False
+            else:
+                tallied[pi] = agg_power[pi]
     return [
         structural_ok[pi] and sig_ok[pi] and tallied[pi] > needed for pi in range(len(pairs))
     ]

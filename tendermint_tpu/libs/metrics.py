@@ -239,6 +239,7 @@ class VerifyMetrics:
                 "batch_size", "queue_wait_seconds", "host_prep_seconds",
                 "device_seconds", "flush_quantum_seconds", "bucket_compiles",
                 "table_cache_hits", "table_cache_misses", "backend_tier",
+                "bls_agg_seconds", "bls_agg_checks",
             ):
                 setattr(self, name, _NOP)
             return
@@ -290,6 +291,13 @@ class VerifyMetrics:
         self.backend_tier = g(
             "backend_tier",
             "Active host crypto backend: 1=cryptography, 2=C extension, 3=pure python.",
+        )
+        self.bls_agg_seconds = h(
+            "bls_agg_seconds",
+            "Wall time per BLS aggregate-commit pairing batch.", time_buckets,
+        )
+        self.bls_agg_checks = c(
+            "bls_agg_checks", "Aggregate-commit claims verified (pairing or memo)."
         )
 
 

@@ -101,6 +101,10 @@ def verify_non_adjacent(
             trust_numerator=trust_level[0],
             trust_denominator=trust_level[1],
             batch_verify=batch_verify,
+            # aggregate (BLS) commits: the signer bitmap indexes the
+            # untrusted header's own set; power is tallied against the
+            # trusted set by address
+            commit_vals=untrusted_vals,
         )
     except NotEnoughVotingPowerError as e:
         raise ErrNewValSetCantBeTrusted(e)

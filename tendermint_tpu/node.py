@@ -197,6 +197,10 @@ class Node(Service):
                 adaptive=cfg.tpu.flush_adaptive,
             )
             await self.async_verifier.start()
+            if cfg.tpu.bls_jax_aggregation:
+                from .crypto.bls import scheme as _bls_scheme
+
+                _bls_scheme.set_jax_aggregation(True)
         # remote signer: wait for the external signer to dial in BEFORE
         # consensus needs a pubkey (node/node.go:612-618)
         if isinstance(self.priv_validator, Service) and not self.priv_validator.is_running:

@@ -17,7 +17,14 @@ import tempfile
 from dataclasses import dataclass, replace
 from typing import Optional, Tuple
 
-from ..crypto.keys import Ed25519PrivKey, PubKey, pubkey_from_dict
+from ..crypto.keys import (
+    Ed25519PrivKey,
+    PrivKey,
+    PubKey,
+    generate_priv_key,
+    privkey_from_dict,
+    pubkey_from_dict,
+)
 from ..types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
 from ..types.priv_validator import PrivValidator
 from ..types.proposal import Proposal
@@ -58,11 +65,13 @@ def _atomic_write_json(path: str, obj: dict) -> None:
 
 @dataclass
 class FilePVKey:
-    """privval/file.go:42 — the immutable key half."""
+    """privval/file.go:42 — the immutable key half.  The priv key may be
+    any registered consensus key type (ed25519 default; sr25519 and
+    bls12381 ride `testnet --key-type`)."""
 
     address: bytes
     pub_key: PubKey
-    priv_key: Ed25519PrivKey
+    priv_key: PrivKey
     file_path: str = ""
 
     def save(self) -> None:
@@ -85,7 +94,9 @@ class FilePVKey:
     def load(cls, path: str) -> "FilePVKey":
         with open(path) as fh:
             d = json.load(fh)
-        priv = Ed25519PrivKey(bytes.fromhex(d["priv_key"]["value"]))
+        priv = privkey_from_dict(
+            {"type": d["priv_key"]["type"], "value": bytes.fromhex(d["priv_key"]["value"])}
+        )
         pub = pubkey_from_dict(
             {"type": d["pub_key"]["type"], "value": bytes.fromhex(d["pub_key"]["value"])}
         )
@@ -165,8 +176,8 @@ class FilePV(PrivValidator):
     # -- construction ------------------------------------------------------
 
     @classmethod
-    def generate(cls, key_file: str, state_file: str) -> "FilePV":
-        priv = Ed25519PrivKey.generate()
+    def generate(cls, key_file: str, state_file: str, key_type: str = "ed25519") -> "FilePV":
+        priv = generate_priv_key(key_type)
         key = FilePVKey(priv.pub_key().address(), priv.pub_key(), priv, key_file)
         return cls(key, FilePVLastSignState(file_path=state_file))
 
@@ -181,11 +192,13 @@ class FilePV(PrivValidator):
         return cls(key, lss)
 
     @classmethod
-    def load_or_generate(cls, key_file: str, state_file: str) -> "FilePV":
+    def load_or_generate(
+        cls, key_file: str, state_file: str, key_type: str = "ed25519"
+    ) -> "FilePV":
         """privval/file.go:185 LoadOrGenFilePV."""
         if os.path.exists(key_file):
             return cls.load(key_file, state_file)
-        pv = cls.generate(key_file, state_file)
+        pv = cls.generate(key_file, state_file, key_type)
         pv.save()
         return pv
 
@@ -202,13 +215,16 @@ class FilePV(PrivValidator):
         return self.key.address
 
     def sign_vote(self, chain_id: str, vote: Vote) -> None:
-        """privval/file.go:296 signVote."""
+        """privval/file.go:296 signVote.  BLS validators sign the
+        timestamp-free aggregation domain (sign_bytes_for_key routing) —
+        the same-HRS re-sign logic then short-circuits on byte equality
+        since timestamps never enter the message."""
         step = _VOTE_STEP.get(vote.type)
         if step is None:
             raise ValueError(f"unknown vote type {vote.type}")
         lss = self.last_sign_state
         same_hrs = lss.check_hrs(vote.height, vote.round, step)
-        sign_bytes = vote.sign_bytes(chain_id)
+        sign_bytes = vote.sign_bytes_for_key(chain_id, self.key.pub_key)
 
         if same_hrs:
             # Idempotent re-sign (e.g. WAL replay asks again): identical
@@ -280,7 +296,10 @@ class FilePV(PrivValidator):
         the vote is the same modulo time."""
         lss = self.last_sign_state
         candidate = replace(vote, timestamp_ns=lss.timestamp_ns, signature=b"")
-        return lss.timestamp_ns, candidate.sign_bytes(chain_id) == lss.sign_bytes
+        return (
+            lss.timestamp_ns,
+            candidate.sign_bytes_for_key(chain_id, self.key.pub_key) == lss.sign_bytes,
+        )
 
     def _proposal_only_differs_by_timestamp(
         self, proposal: Proposal, chain_id: str
@@ -296,5 +315,7 @@ class FilePV(PrivValidator):
 def load_or_gen_file_pv(config) -> FilePV:
     """DefaultNewNode's privval hook (node/node.go:115) from a Config."""
     return FilePV.load_or_generate(
-        config.priv_validator_key_file(), config.priv_validator_state_file()
+        config.priv_validator_key_file(),
+        config.priv_validator_state_file(),
+        getattr(config.base, "key_type", "ed25519"),
     )

@@ -146,28 +146,23 @@ codec.register("tm/State")(State)
 
 def median_time(commit: Commit, validators: ValidatorSet) -> int:
     """Power-weighted median of commit timestamps (state/state.go:166
-    MedianTime; BFT-time spec).  Deterministic across nodes."""
-    weighted = []
-    total_power = 0
-    for cs in commit.signatures:
-        if cs.is_absent():
-            continue
-        _, val = validators.get_by_address(cs.validator_address)
-        if val is not None:
-            total_power += val.voting_power
-            weighted.append((cs.timestamp_ns, val.voting_power))
-    if total_power == 0:
-        # no commit signature resolved in the validator set — an impossible
-        # state for a valid commit; fail loudly rather than emit time 0
-        raise ValueError("median_time: no commit signatures match the validator set")
-    weighted.sort()
-    median = total_power // 2
-    acc = 0
-    for ts, power in weighted:
-        if acc + power > median:
-            return ts
-        acc += power
-    raise AssertionError("unreachable: weighted median not found")
+    MedianTime; BFT-time spec).  Deterministic across nodes.
+
+    An AggregateCommit carries ONE timestamp, computed at fold time by
+    the SAME weighted-median rule from the per-vote timestamps it
+    summarizes — so it is returned directly.  Trust model caveat: BLS
+    votes sign timestamp-free bytes, so nobody can re-derive that median
+    from signatures; on all-BLS nets block time is proposer-attested,
+    bounded by header monotonicity (validate_block) and the propose-side
+    clock-drift prevote gate rather than by the median equality check
+    (which degenerates to comparing the proposer's value to itself)."""
+    from ..types.agg_commit import AggregateCommit, weighted_median_timestamp
+
+    if isinstance(commit, AggregateCommit):
+        return commit.timestamp_ns
+    # one canonical implementation of the median rule (it also runs at
+    # fold time, where consensus-critical divergence would be fatal)
+    return weighted_median_timestamp(commit, validators)
 
 
 def make_genesis_state(gen_doc: GenesisDoc) -> State:

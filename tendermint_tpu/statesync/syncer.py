@@ -73,7 +73,23 @@ class EngineCommitPreverify:
         self._cache: Dict[Tuple[bytes, bytes, bytes], bool] = {}
 
     async def __call__(self, sh: SignedHeader, vals_sets: List[ValidatorSet]):
+        from ..types.agg_commit import AggregateCommit
+
         vals = vals_sets[0]  # index-aligned set; other sets share pubkeys by address
+        if isinstance(sh.commit, AggregateCommit):
+            # ONE pairing claim for the whole commit, run on the engine's
+            # flush executor; the scheme memo it warms serves the
+            # synchronous verify_commit/verify_commit_trusting that follow
+            if vals.size() != sh.commit.signers.bits:
+                return None
+            pks = [
+                vals.validators[i].pub_key.bytes()
+                for i in sh.commit.signers.true_indices()
+            ]
+            await self.async_verifier.verify_bls_aggregates(
+                [(pks, sh.commit.sign_message(sh.header.chain_id), sh.commit.agg_sig)]
+            )
+            return None  # sync path routes through the aggregate branch + memo
         if vals.size() != len(sh.commit.signatures):
             return None  # malformed; let verify_commit raise its own error
         items = []

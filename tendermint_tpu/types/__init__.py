@@ -40,6 +40,13 @@ from .vote import (
     VoteError,
     ErrVoteConflictingVotes,
 )
+from .agg_commit import (
+    AggregateCommit,
+    AggregateLastCommit,
+    commit_from_dict,
+    fold_commit,
+    set_is_uniform_bls,
+)
 from .proposal import Proposal
 from .validator import (
     Validator,

@@ -322,11 +322,26 @@ class Commit:
             signature=cs.signature,
         )
 
-    def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
+    def vote_sign_bytes(self, chain_id: str, val_idx: int, pub_key=None) -> bytes:
         """Sign-bytes for slot val_idx (types/block.go:621) — only the
-        timestamp differs between validators."""
+        timestamp differs between validators.  When `pub_key` identifies a
+        BLS validator, the timestamp-free aggregation domain applies (the
+        slot in a mixed-set commit routes per scheme)."""
         cs = self.signatures[val_idx]
         bid = cs.block_id(self.block_id)
+        if pub_key is not None:
+            from .vote import is_bls_key
+
+            if is_bls_key(pub_key):
+                return canonical.canonical_vote_sign_bytes_no_ts(
+                    chain_id,
+                    canonical.PRECOMMIT_TYPE,
+                    self.height,
+                    self.round,
+                    bid.hash,
+                    bid.parts_header.total,
+                    bid.parts_header.hash,
+                )
         return canonical.canonical_vote_sign_bytes(
             chain_id,
             canonical.PRECOMMIT_TYPE,
@@ -533,11 +548,13 @@ class Block:
 
     @classmethod
     def from_dict(cls, d: dict) -> "Block":
+        from .agg_commit import commit_from_dict
+
         return cls(
             header=Header.from_dict(d["header"]),
             txs=d["txs"],
             evidence=[codec.loads(e) for e in d["evidence"]],
-            last_commit=Commit.from_dict(d["last_commit"]) if d["last_commit"] else None,
+            last_commit=commit_from_dict(d["last_commit"]),
         )
 
     def __repr__(self) -> str:
@@ -588,7 +605,9 @@ class SignedHeader:
 
     @classmethod
     def from_dict(cls, d: dict) -> "SignedHeader":
-        return cls(Header.from_dict(d["header"]), Commit.from_dict(d["commit"]))
+        from .agg_commit import commit_from_dict
+
+        return cls(Header.from_dict(d["header"]), commit_from_dict(d["commit"]))
 
 
 codec.register("tm/SignedHeader")(SignedHeader)

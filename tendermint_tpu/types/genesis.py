@@ -23,15 +23,24 @@ class GenesisValidator:
     pub_key: PubKey
     power: int
     name: str = ""
+    # BLS12-381 proof of possession (96B signature over the pubkey, DST
+    # BLS_POP_*).  REQUIRED for BLS validators: FastAggregateVerify — the
+    # single pairing check behind aggregate commits — is only sound against
+    # rogue-key attacks when every key in the set proved possession, and
+    # genesis is where this framework's validator keys enter the set.
+    pop: bytes = b""
 
     def to_dict(self) -> dict:
         pk = self.pub_key.to_dict()
-        return {
+        d = {
             "address": self.address.hex().upper(),
             "pub_key": {"type": pk["type"], "value": base64.b64encode(pk["value"]).decode()},
             "power": str(self.power),
             "name": self.name,
         }
+        if self.pop:
+            d["pop"] = base64.b64encode(self.pop).decode()
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "GenesisValidator":
@@ -39,7 +48,13 @@ class GenesisValidator:
             {"type": d["pub_key"]["type"], "value": base64.b64decode(d["pub_key"]["value"])}
         )
         addr = bytes.fromhex(d["address"]) if d.get("address") else b""
-        return cls(address=addr, pub_key=pk, power=int(d["power"]), name=d.get("name", ""))
+        return cls(
+            address=addr,
+            pub_key=pk,
+            power=int(d["power"]),
+            name=d.get("name", ""),
+            pop=base64.b64decode(d["pop"]) if d.get("pop") else b"",
+        )
 
 
 @dataclass
@@ -74,8 +89,39 @@ class GenesisDoc:
                 raise ValueError(f"incorrect address for validator {v} in the genesis file")
             if not v.address:
                 v.address = v.pub_key.address()
+        self._validate_bls_pops()
         if self.genesis_time_ns == 0:
             self.genesis_time_ns = time.time_ns()
+
+    def _validate_bls_pops(self) -> None:
+        """Every BLS12-381 validator must carry a VALID proof of
+        possession.  FastAggregateVerify — the single pairing check behind
+        aggregate commits — is only sound against rogue-key attacks for
+        PoP-checked key sets, and genesis is the ONLY door BLS keys have
+        into a validator set (ABCI validator updates admit ed25519 only,
+        types/protobuf.go parity in state/execution.py)."""
+        from .vote import is_bls_key
+
+        bls = [v for v in self.validators if is_bls_key(v.pub_key)]
+        if not bls:
+            return
+        for v in bls:
+            if not v.pop:
+                raise ValueError(
+                    f"BLS validator {v.name or v.address.hex()} has no proof of "
+                    "possession; aggregate verification would be rogue-key-forgeable"
+                )
+        from ..crypto.bls import scheme
+
+        if scheme.batch_pop_verify([(v.pub_key.bytes(), v.pop) for v in bls]):
+            return
+        for v in bls:  # attribute the liar
+            if not scheme.pop_verify(v.pub_key.bytes(), v.pop):
+                raise ValueError(
+                    f"invalid BLS proof of possession for validator "
+                    f"{v.name or v.address.hex()}"
+                )
+        raise ValueError("BLS proof-of-possession batch check failed")
 
     # -- JSON file round-trip ---------------------------------------------
     def to_json(self) -> str:

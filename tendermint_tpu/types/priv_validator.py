@@ -49,7 +49,7 @@ class MockPV(PrivValidator):
     `break_*` flags corrupt sign-bytes for byzantine tests
     (erroringMockPV equivalents)."""
 
-    def __init__(self, priv_key: Ed25519PrivKey | None = None, break_proposal_signing: bool = False, break_vote_signing: bool = False):
+    def __init__(self, priv_key=None, break_proposal_signing: bool = False, break_vote_signing: bool = False):
         self.priv_key = priv_key or Ed25519PrivKey.generate()
         self.break_proposal_signing = break_proposal_signing
         self.break_vote_signing = break_vote_signing
@@ -62,7 +62,9 @@ class MockPV(PrivValidator):
 
     def sign_vote(self, chain_id: str, vote: Vote) -> None:
         use_chain_id = "incorrect-chain-id" if self.break_vote_signing else chain_id
-        vote.signature = self.priv_key.sign(vote.sign_bytes(use_chain_id))
+        vote.signature = self.priv_key.sign(
+            vote.sign_bytes_for_key(use_chain_id, self.get_pub_key())
+        )
 
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
         use_chain_id = "incorrect-chain-id" if self.break_proposal_signing else chain_id

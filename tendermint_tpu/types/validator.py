@@ -446,6 +446,68 @@ class ValidatorSet:
         delete_addrs = {v.address for v in deletes}
         self.validators = [v for v in self.validators if v.address not in delete_addrs]
 
+    # -- aggregate (BLS) commit verification -------------------------------
+    def verify_aggregate_commit(
+        self,
+        chain_id: str,
+        block_id: BlockID,
+        height: int,
+        commit,
+        needed: int,
+        commit_vals: Optional["ValidatorSet"] = None,
+    ) -> None:
+        """ONE pairing check for an AggregateCommit: e(Σpk_bitmap, H(m)) ·
+        e(-g1, σ) == 1, with power tallied against SELF.  `commit_vals` is
+        the set the bitmap indexes (the commit's own set); when omitted it
+        is this set (verify_commit).  The scheme memo means an async
+        pre-verify lane (statesync/lite2/fastsync) that already paired
+        this commit serves the check without re-pairing."""
+        commit.validate_basic()
+        if height != commit.height:
+            raise ValueError(f"invalid commit height: want {height}, got {commit.height}")
+        if block_id != commit.block_id:
+            raise ValueError(
+                f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
+            )
+        bitmap_vals = commit_vals if commit_vals is not None else self
+        if commit.signers.bits != bitmap_vals.size():
+            raise ValueError(
+                f"invalid aggregate commit -- wrong bitmap size: "
+                f"{commit.signers.bits} vs {bitmap_vals.size()}"
+            )
+        from .vote import is_bls_key
+
+        idxs = commit.signers.true_indices()
+        pks = []
+        for i in idxs:
+            pk = bitmap_vals.validators[i].pub_key
+            if not is_bls_key(pk):
+                raise ValueError(f"aggregate commit signer #{i} is not a BLS12-381 key")
+            pks.append(pk.bytes())
+        msg = commit.sign_message(chain_id)
+
+        from ..crypto.bls import scheme
+
+        ok = scheme.memo_get(pks, msg, commit.agg_sig)
+        if ok is None:
+            ok = scheme.fast_aggregate_verify(pks, msg, commit.agg_sig)
+            scheme.memo_put(pks, msg, commit.agg_sig, ok)
+        if not ok:
+            raise ValueError("invalid aggregate commit signature")
+
+        if bitmap_vals is self:
+            tallied = sum(self.validators[i].voting_power for i in idxs)
+        else:
+            # trusting/future checks: the bitmap indexes the commit's set;
+            # credit only signers that are also members of THIS set
+            tallied = 0
+            for i in idxs:
+                _, val = self.get_by_address(bitmap_vals.validators[i].address)
+                if val is not None:
+                    tallied += val.voting_power
+        if tallied <= needed:
+            raise NotEnoughVotingPowerError(got=tallied, needed=needed)
+
     # -- batched commit verification (the TPU hot path) --------------------
     def verify_commit(
         self,
@@ -458,8 +520,17 @@ class ValidatorSet:
         """+2/3 of this set signed the commit (types/validator_set.go:629).
 
         Signatures and validators are index-aligned, so pubkeys gather by
-        index straight into the batch — no address lookups.
+        index straight into the batch — no address lookups.  Aggregate
+        (BLS) commits route to the single-pairing check instead.
         """
+        from .agg_commit import AggregateCommit
+
+        if isinstance(commit, AggregateCommit):
+            self.verify_aggregate_commit(
+                chain_id, block_id, height, commit,
+                needed=self.total_voting_power() * 2 // 3,
+            )
+            return
         if self.size() != len(commit.signatures):
             raise ValueError(
                 f"invalid commit -- wrong set size: {self.size()} vs {len(commit.signatures)}"
@@ -471,8 +542,9 @@ class ValidatorSet:
             if cs.is_absent():
                 continue
             idxs.append(idx)
-            pubkeys.append(self.validators[idx].pub_key)
-            msgs.append(commit.vote_sign_bytes(chain_id, idx))
+            pk = self.validators[idx].pub_key
+            pubkeys.append(pk)
+            msgs.append(commit.vote_sign_bytes(chain_id, idx, pub_key=pk))
             sigs.append(cs.signature)
 
         indexed = None
@@ -513,6 +585,18 @@ class ValidatorSet:
         commit must be valid for new_set AND >2/3 of the old set signed."""
         new_set.verify_commit(chain_id, block_id, height, commit, batch_verify)
 
+        from .agg_commit import AggregateCommit
+
+        if isinstance(commit, AggregateCommit):
+            # signature already checked (and memoized) against new_set
+            # above; this pass re-tallies the bitmap against the OLD set
+            self.verify_aggregate_commit(
+                chain_id, block_id, height, commit,
+                needed=self.total_voting_power() * 2 // 3,
+                commit_vals=new_set,
+            )
+            return
+
         old_voting_power = 0
         seen = set()
         idxs, powers, pubkeys, msgs, sigs = [], [], [], [], []
@@ -526,7 +610,7 @@ class ValidatorSet:
             idxs.append(idx)
             powers.append(val.voting_power)
             pubkeys.append(val.pub_key)
-            msgs.append(commit.vote_sign_bytes(chain_id, idx))
+            msgs.append(commit.vote_sign_bytes(chain_id, idx, pub_key=val.pub_key))
             sigs.append(cs.signature)
 
         ok = mixed_batch_verify(pubkeys, msgs, sigs, batch_verify)
@@ -550,15 +634,31 @@ class ValidatorSet:
         trust_numerator: int = 1,
         trust_denominator: int = 3,
         batch_verify: Optional[Callable] = None,
+        commit_vals: Optional["ValidatorSet"] = None,
     ) -> None:
         """trustLevel of this (old, trusted) set signed the commit — the
         lite2 skipping-verification core (types/validator_set.go:754).
         Validators are matched by address since the commit may belong to a
-        different validator set."""
+        different validator set.  For an AggregateCommit the bitmap indexes
+        the commit's OWN set, so callers must supply it as `commit_vals`
+        (lite2 always holds it — it is the untrusted header's set)."""
         if trust_numerator * 3 < trust_denominator or trust_numerator > trust_denominator:
             raise ValueError(
                 f"trustLevel must be within [1/3, 1], given {trust_numerator}/{trust_denominator}"
             )
+        from .agg_commit import AggregateCommit
+
+        if isinstance(commit, AggregateCommit):
+            if commit_vals is None:
+                raise ValueError(
+                    "aggregate commit trusting-verify requires the commit's validator set"
+                )
+            self.verify_aggregate_commit(
+                chain_id, block_id, height, commit,
+                needed=self.total_voting_power() * trust_numerator // trust_denominator,
+                commit_vals=commit_vals,
+            )
+            return
         _verify_commit_basic(commit, height, block_id)
 
         seen_vals = {}
@@ -576,7 +676,7 @@ class ValidatorSet:
             row_idxs.append(val_idx)
             powers.append(val.voting_power)
             pubkeys.append(val.pub_key)
-            msgs.append(commit.vote_sign_bytes(chain_id, idx))
+            msgs.append(commit.vote_sign_bytes(chain_id, idx, pub_key=val.pub_key))
             sigs.append(cs.signature)
 
         indexed = None

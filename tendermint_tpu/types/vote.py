@@ -18,6 +18,13 @@ class VoteError(Exception):
     pass
 
 
+def is_bls_key(pub_key) -> bool:
+    """True for BLS12-381 keys (lazy import: the BLS tower must not load
+    for ed25519-only nets)."""
+    t = getattr(pub_key, "TYPE", None)
+    return t == "tendermint/PubKeyBLS12381"
+
+
 class ErrVoteConflictingVotes(VoteError):
     """Raised by VoteSet on double-sign; carries the evidence
     (types/vote.go:29)."""
@@ -66,6 +73,30 @@ class Vote:
             self.timestamp_ns,
         )
 
+    def bls_sign_bytes(self, chain_id: str) -> bytes:
+        """Timestamp-free sign-bytes — the message BLS validators sign so
+        that every precommit for one block is aggregatable into a single
+        pairing check (canonical.canonical_vote_sign_bytes_no_ts)."""
+        return canonical.canonical_vote_sign_bytes_no_ts(
+            chain_id,
+            self.type,
+            self.height,
+            self.round,
+            self.block_id.hash,
+            self.block_id.parts_header.total,
+            self.block_id.parts_header.hash,
+        )
+
+    def sign_bytes_for_key(self, chain_id: str, pub_key) -> bytes:
+        """Per-scheme sign-bytes routing: BLS validators sign (and are
+        verified against) the timestamp-free domain; every other key type
+        keeps the reference layout.  All verification paths — VoteSet,
+        the reactor's batch pre-verify, commit checks — route through
+        this so ed25519/sr25519 nets are untouched."""
+        if is_bls_key(pub_key):
+            return self.bls_sign_bytes(chain_id)
+        return self.sign_bytes(chain_id)
+
     def commit_sig(self) -> CommitSig:
         """types/vote.go:60."""
         if self.block_id.is_complete():
@@ -86,7 +117,7 @@ class Vote:
         hot path routes through crypto.batch_verifier instead."""
         if pub_key.address() != self.validator_address:
             raise VoteError("invalid validator address")
-        if not pub_key.verify(self.sign_bytes(chain_id), self.signature):
+        if not pub_key.verify(self.sign_bytes_for_key(chain_id, pub_key), self.signature):
             raise VoteError("invalid signature")
 
     def validate_basic(self) -> None:

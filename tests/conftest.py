@@ -91,7 +91,13 @@ def pytest_pyfunc_call(pyfuncitem):
             # un-stopped task survives the window and is flagged.
             leaked = [t for t in asyncio.all_tasks(loop) if not t.done()]
             if leaked:
-                loop.run_until_complete(asyncio.wait(leaked, timeout=0.25))
+                # 2 s, not a few hundred ms: BLS reference-tier tests run
+                # ~0.5 s pure-python pairings on executor threads that HOLD
+                # the GIL, so on a saturated CI box a normal cancellation
+                # cascade can need most of a second of loop time to unwind.
+                # A genuinely un-stopped task (server, ticker, routine)
+                # survives any window and is still flagged.
+                loop.run_until_complete(asyncio.wait(leaked, timeout=2.0))
                 leaked = [t for t in leaked if not t.done()]
             if leaked:
                 names = ", ".join(

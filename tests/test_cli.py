@@ -80,6 +80,51 @@ class TestTestnet:
         assert len(genesis_hashes) == 1  # identical genesis everywhere
         assert len(set(ids)) == 4
 
+    def test_bls_key_type_end_to_end(self, tmp_path):
+        """Satellite: `testnet --key-type bls12381` end to end — keygen,
+        address derivation, key-file round-trip, and a PoP-carrying
+        genesis that passes the rogue-key gate."""
+        from tendermint_tpu.crypto.bls import BlsPubKey
+        from tendermint_tpu.crypto.tmhash import sum_truncated
+        from tendermint_tpu.privval.file import FilePV
+        from tendermint_tpu.types import GenesisDoc
+
+        out = str(tmp_path / "blsnet")
+        assert run_cli("testnet", "-v", "3", "-o", out, "--key-type", "bls12381",
+                       "--chain-id", "bls-tn") == 0
+        gen = None
+        for i in range(3):
+            home = os.path.join(out, f"node{i}")
+            cfg = load_config(os.path.join(home, "config", "config.toml"), home=home)
+            assert cfg.base.key_type == "bls12381"
+            pv = FilePV.load(
+                cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+            )
+            pub = pv.get_pub_key()
+            assert isinstance(pub, BlsPubKey) and len(pub.bytes()) == 48
+            assert pv.address() == sum_truncated(pub.bytes())
+            again = FilePV.load(
+                cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+            )
+            assert again.get_pub_key().bytes() == pub.bytes()
+            assert again.address() == pv.address()
+            gen = GenesisDoc.from_file(cfg.genesis_file())
+            gen.validate_and_complete()  # PoP enforcement must pass on real files
+        assert all(
+            isinstance(v.pub_key, BlsPubKey) and v.pop for v in gen.validators
+        )
+        # `init --key-type bls12381` takes the same path for a solo node
+        solo = str(tmp_path / "solo")
+        assert run_cli("--home", solo, "init", "--chain-id", "bls-solo",
+                       "--key-type", "bls12381") == 0
+        cfg = load_config(os.path.join(solo, "config", "config.toml"), home=solo)
+        assert cfg.base.key_type == "bls12381"
+        pv = FilePV.load(
+            cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+        )
+        assert isinstance(pv.get_pub_key(), BlsPubKey)
+        GenesisDoc.from_file(cfg.genesis_file()).validate_and_complete()
+
     async def test_localnet_from_generated_configs(self, tmp_path):
         """Launch all 4 nodes exactly as `node` would (default_new_node on
         the generated config tree) and watch them commit together."""
