@@ -411,6 +411,32 @@ def bench_scale_100val():
     return json.loads(run.stdout.strip().splitlines()[-1])
 
 
+def bench_load():
+    """Overload acceptance as numbers: run the tx-ingress firehose rig
+    (networks/local/load_smoke.py — QoS-configured 4-val localnet, chaos
+    invariant checker scraping underneath a saturating signed-tx
+    firehose) and report `tx_ingress_sustained_tps` (accepted tx/sec at
+    admission under >= 2x offered load) and `commit_latency_under_load_ms`
+    (p90 commit interval from the target node's flight recorder while the
+    firehose runs).  Raises if any invariant failed — silent drops, a
+    commit stall, or an unrecovered post-firehose commit rate fail the
+    smoke, not just the bench."""
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as tmp:
+        run = subprocess.run(
+            [sys.executable, os.path.join(repo, "networks", "local", "load_smoke.py"),
+             "--build-dir", os.path.join(tmp, "build"), "--base-port", "31856", "--json"],
+            capture_output=True, text=True, timeout=420, cwd=repo,
+        )
+        if run.returncode != 0:
+            raise RuntimeError(f"load smoke failed:\n{run.stdout}\n{run.stderr}")
+        return json.loads(run.stdout.strip().splitlines()[-1])
+
+
 def bench_statesync_bootstrap():
     """Statesync bootstrap time, measured from REAL recorder spans: an
     empty 4th node joins a live 3-validator localnet via snapshot restore
@@ -767,6 +793,10 @@ def main() -> None:
         scale = bench_scale_100val()
     except Exception as e:
         scale = {"e2e_commits_per_sec_100val": -1.0, "error": str(e)[:300]}
+    try:
+        load = bench_load()
+    except Exception as e:
+        load = {"tx_ingress_sustained_tps": -1.0, "error": str(e)[:300]}
     extras = {
         "commit_verify_100val_ms": bench_100val_commit(),
         "e2e_commits_per_sec_solo": asyncio.run(bench_e2e_commits()),
@@ -803,6 +833,12 @@ def main() -> None:
         "e2e_4val_procs_startup_s": procs.get("startup_s"),
         "statesync_bootstrap_ms": statesync.get("statesync_bootstrap_ms", -1.0),
         "statesync_bootstrap_wall_s": statesync.get("bootstrap_wall_s"),
+        "tx_ingress_sustained_tps": load.get("tx_ingress_sustained_tps", -1.0),
+        "commit_latency_under_load_ms": load.get("commit_latency_under_load_ms", -1.0),
+        "load_offered_tps": load.get("offered_tps"),
+        "load_throttled": load.get("throttled"),
+        "load_idle_commits_per_sec": load.get("idle_commits_per_sec"),
+        "load_recovery_commits_per_sec": load.get("recovery_commits_per_sec"),
         "chaos_partition_recovery_ms": chaos.get("chaos_partition_recovery_ms", -1.0),
         "chaos_restart_recovery_ms": chaos.get("restart_recovery_ms"),
         "chaos_evidence_height": chaos.get("evidence_height"),

@@ -137,7 +137,13 @@ class KVStoreApplication(t.Application):
     def check_tx(self, req: t.RequestCheckTx) -> t.ResponseCheckTx:
         if self._is_validator_tx(req.tx) and self._parse_validator_tx(req.tx) is None:
             return t.ResponseCheckTx(code=1, log="invalid validator tx")
-        return t.ResponseCheckTx(code=t.CODE_TYPE_OK, gas_wanted=1)
+        # honor a fee:<n>: payload prefix as mempool priority (QoS demo:
+        # the builtin app is what the load rigs drive)
+        from ..mempool import tx_priority
+
+        return t.ResponseCheckTx(
+            code=t.CODE_TYPE_OK, gas_wanted=1, priority=tx_priority(req.tx)
+        )
 
     def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
         if self._is_validator_tx(req.tx):

@@ -246,6 +246,9 @@ class ResponseCheckTx:
     gas_used: int = 0
     events: List[Event] = field(default_factory=list)
     codespace: str = ""
+    # QoS rank for the priority mempool (the v0.35 direction): higher
+    # reaps first and survives eviction longer; 0 = FIFO default
+    priority: int = 0
 
     @property
     def is_ok(self) -> bool:

@@ -49,6 +49,25 @@ class RPCConfig:
     max_body_bytes: int = 1000000
     max_header_bytes: int = 1 << 20
     cors_allowed_origins: List[str] = field(default_factory=list)
+    # -- ingress admission control (no reference counterpart; overload
+    # robustness layer).  Every rejection is EXPLICIT: SERVER_OVERLOADED
+    # (-32005) with a retry_after hint — never silent queueing.
+    # Per-source token-bucket rate limit on broadcast_tx_* (txs/sec per
+    # client address; 0 disables).  One hot client exhausts its own
+    # bucket, not the node.
+    broadcast_rate: float = 0.0
+    broadcast_rate_burst: int = 200
+    # Bound on concurrently in-flight broadcast CheckTx work across all
+    # sources (0 = unbounded).  broadcast_tx_async used to spawn an
+    # unbounded task per request — the firehose-starves-consensus lever.
+    max_broadcast_inflight: int = 1024
+    # Bound on concurrent broadcast_tx_commit waiters (each holds an
+    # event-bus subscription for up to timeout_broadcast_tx_commit; 0 =
+    # unbounded).
+    max_commit_waiters: int = 64
+    # JSON-RPC batch POST length cap: a single request must not fan out
+    # into thousands of concurrent handler tasks.
+    max_batch_request_items: int = 100
 
 
 @dataclass
@@ -93,6 +112,16 @@ class MempoolConfig:
     # through the shared verify engine BEFORE the ABCI round-trip; a burst
     # of CheckTx calls coalesces into one device/host batch.
     sig_precheck: bool = False
+    # Total on-disk bound for the mempool tx WAL (head + rotated chunks;
+    # libs/autofile.Group — the consensus WAL's head-size-limit pattern).
+    # Under sustained ingress the journal used to grow without limit.
+    wal_size_limit: int = 16 * 1024 * 1024
+    # Per-peer mempool-gossip pacing: outbound tx frames to one peer are
+    # token-bucket paced to this many bytes/sec (0 = unpaced), so tx
+    # flooding shares each link with consensus traffic instead of
+    # saturating it.  Frames are also capped at broadcast_batch_bytes.
+    broadcast_rate_bytes: int = 1048576
+    broadcast_batch_bytes: int = 65536
 
     def as_dict(self) -> dict:
         return {
@@ -103,6 +132,9 @@ class MempoolConfig:
             "max_tx_bytes": self.max_tx_bytes,
             "keep_invalid_txs_in_cache": self.keep_invalid_txs_in_cache,
             "sig_precheck": self.sig_precheck,
+            "wal_size_limit": self.wal_size_limit,
+            "broadcast_rate_bytes": self.broadcast_rate_bytes,
+            "broadcast_batch_bytes": self.broadcast_batch_bytes,
         }
 
 
@@ -368,8 +400,24 @@ class Config:
                 raise ValueError(f"consensus.{name} can't be negative")
         if self.mempool.size < 0:
             raise ValueError("mempool.size can't be negative")
+        if self.mempool.wal_size_limit < 4096:
+            raise ValueError("mempool.wal_size_limit must be >= 4096")
+        if self.mempool.broadcast_rate_bytes < 0:
+            raise ValueError("mempool.broadcast_rate_bytes can't be negative")
+        if self.mempool.broadcast_batch_bytes < 1024:
+            raise ValueError("mempool.broadcast_batch_bytes must be >= 1024")
         if self.rpc.max_open_connections < 0:
             raise ValueError("rpc.max_open_connections can't be negative")
+        if self.rpc.broadcast_rate < 0:
+            raise ValueError("rpc.broadcast_rate can't be negative")
+        if self.rpc.broadcast_rate_burst < 1:
+            raise ValueError("rpc.broadcast_rate_burst must be >= 1")
+        if self.rpc.max_broadcast_inflight < 0:
+            raise ValueError("rpc.max_broadcast_inflight can't be negative")
+        if self.rpc.max_commit_waiters < 0:
+            raise ValueError("rpc.max_commit_waiters can't be negative")
+        if self.rpc.max_batch_request_items < 1:
+            raise ValueError("rpc.max_batch_request_items must be >= 1")
         if self.fast_sync.version not in ("v0", "v2"):
             raise ValueError(f"unknown fastsync version {self.fast_sync.version!r}")
         if self.instrumentation.flight_recorder_size < 1:

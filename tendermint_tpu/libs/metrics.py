@@ -182,7 +182,11 @@ class P2PMetrics:
 
 
 class MempoolMetrics:
-    """mempool/metrics.go."""
+    """mempool/metrics.go + the priority-QoS series (no reference
+    counterpart: the reference mempool has no priority lane to observe).
+    `priority_evicted` counts txs displaced by better-paying arrivals when
+    the pool is full; `priority_floor` is the priority of the most recent
+    eviction victim — the going rate a tx must beat to enter a full pool."""
 
     def __init__(self, registry=None, chain_id: str = ""):
         if registry is None:
@@ -190,8 +194,10 @@ class MempoolMetrics:
             self.tx_size_bytes = _NOP
             self.failed_txs = _NOP
             self.recheck_times = _NOP
+            self.priority_evicted = _NOP
+            self.priority_floor = _NOP
             return
-        from prometheus_client import Gauge, Histogram
+        from prometheus_client import Counter, Gauge, Histogram
 
         sub = "mempool"
         kw = dict(namespace=NAMESPACE, subsystem=sub, registry=registry,
@@ -206,6 +212,56 @@ class MempoolMetrics:
         # prometheus_client appends `_total` to Counter names
         self.failed_txs = Gauge("failed_txs", "Number of failed transactions.", **kw).labels(chain_id=chain_id)
         self.recheck_times = Gauge("recheck_times", "Number of times transactions are rechecked in the mempool.", **kw).labels(chain_id=chain_id)
+        # tendermint_mempool_priority_evicted_total / _priority_floor
+        self.priority_evicted = Counter(
+            "priority_evicted",
+            "Txs evicted from a full mempool to admit a higher-priority tx.",
+            **kw,
+        ).labels(chain_id=chain_id)
+        self.priority_floor = Gauge(
+            "priority_floor",
+            "Priority of the most recent eviction victim (the bar a tx "
+            "must clear to enter a full pool).",
+            **kw,
+        ).labels(chain_id=chain_id)
+
+
+class RPCMetrics:
+    """RPC ingress admission control (subsystem `rpc`; no reference
+    counterpart — the reference RPC server sheds nothing).  `throttled`
+    counts EXPLICIT overload rejections by reason (rate | inflight |
+    mempool_full | commit_waiters) — the `tendermint_rpc_throttled_total`
+    series the load rig asserts is nonzero under a firehose; the gauges
+    expose the two bounded queues admission control maintains."""
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        if registry is None:
+            self.throttled = _NOP
+            self.broadcast_inflight = _NOP
+            self.commit_waiters = _NOP
+            return
+        from prometheus_client import Counter, Gauge
+
+        sub = "rpc"
+        self.throttled = _BoundLabels(
+            Counter(
+                "throttled",
+                "Broadcast requests rejected with an explicit overload error.",
+                namespace=NAMESPACE, subsystem=sub, registry=registry,
+                labelnames=("chain_id", "reason"),
+            ),
+            chain_id=chain_id,
+        )
+        kw = dict(namespace=NAMESPACE, subsystem=sub, registry=registry,
+                  labelnames=("chain_id",))
+        self.broadcast_inflight = Gauge(
+            "broadcast_inflight", "Broadcast CheckTx calls currently in flight.", **kw
+        ).labels(chain_id=chain_id)
+        self.commit_waiters = Gauge(
+            "commit_waiters",
+            "broadcast_tx_commit calls currently holding an event-bus subscription.",
+            **kw,
+        ).labels(chain_id=chain_id)
 
 
 class StateMetrics:
@@ -491,6 +547,7 @@ class MetricsProvider:
         self.consensus = ConsensusMetrics(self.registry, chain_id)
         self.p2p = P2PMetrics(self.registry, chain_id)
         self.mempool = MempoolMetrics(self.registry, chain_id)
+        self.rpc = RPCMetrics(self.registry, chain_id)
         self.state = StateMetrics(self.registry, chain_id)
         self.verify = VerifyMetrics(self.registry, chain_id)
         self.loop = LoopMetrics(self.registry, chain_id)

@@ -53,6 +53,16 @@ Event kinds currently emitted:
     statesync.chunk   index, total, peer       chunk hash-verified + applied
     statesync.restore height, ms               app restored + checked vs verified header
     statesync.handover  height                 restored state handed to fastsync
+  ingress (rpc/core.py + mempool.py, overload admission control):
+    ingress.throttle  reason[, source]         a broadcast request was rejected
+                                               with an explicit overload error
+                                               (reason rate|inflight|
+                                               mempool_full|commit_waiters);
+                                               HIGH-RATE — subject to
+                                               trace_sample_high_rate
+    ingress.evict     n, priority, size        a full mempool evicted n
+                                               lower-priority txs to admit one
+                                               of the given priority
   evidence (evidence.py, accountability pipeline):
     evidence.add      height, hash             evidence verified into the pool
     evidence.commit   height, hash             evidence committed into a block

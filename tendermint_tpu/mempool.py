@@ -1,10 +1,22 @@
-"""Mempool: ordered tx pool with app-side validation and recheck.
+"""Mempool: priority-ordered tx pool with app-side validation and recheck.
 
 Reference parity: mempool/clist_mempool.go (CheckTx:213, Update:529,
 recheckTxs:591, ReapMaxBytesMaxGas:471, mapTxCache:641) + the
 mempool/mempool.go interface.  The reference's concurrent linked list
 becomes an insertion-ordered dict guarded by the event loop (single-task
 mutation) plus an asyncio lock for the commit window.
+
+QoS redesign (overload robustness; the v0.35 priority-mempool direction):
+admission runs CHEAPEST-FIRST — structural size/envelope checks, then
+dedup, then the full-pool decision — so garbage, duplicates and
+would-be-rejected txs never buy a signature verify or an app round-trip
+(the DoS lever of arXiv:2302.00418: unmetered signature work at ingress).
+Storage is priority-ordered: `reap_max_bytes_max_gas` drains highest
+priority first, and a full pool EVICTS its lowest-priority txs to admit a
+better one instead of hard-rejecting it.  Priority comes from the app's
+CheckTx response (`ResponseCheckTx.priority`) or a client-declared
+``fee:<n>:`` payload prefix (`tx_priority`); default 0 preserves the
+reference's FIFO behavior exactly.
 """
 
 from __future__ import annotations
@@ -56,6 +68,27 @@ def parse_signed_tx(tx: bytes) -> Optional[tuple]:
     return pubkey, SIGNED_TX_DOMAIN + payload, sig, payload
 
 
+def tx_payload(tx: bytes) -> bytes:
+    """The application payload: envelope stripped if present."""
+    parsed = parse_signed_tx(tx)
+    return parsed[3] if parsed is not None else tx
+
+
+def tx_priority(tx: bytes) -> int:
+    """Client-declared fee priority: a ``fee:<digits>:`` payload prefix
+    (inside the signed envelope when there is one).  0 when absent — the
+    structural parse is a few byte compares, cheap enough for the
+    admission fast path."""
+    payload = tx_payload(tx)
+    if payload.startswith(b"fee:"):
+        end = payload.find(b":", 4)
+        if 4 < end <= 23:  # bounded digits: no big-int parse from the wire
+            digits = payload[4:end]
+            if digits.isdigit():
+                return int(digits)
+    return 0
+
+
 class TxInCacheError(MempoolError):
     """mempool/errors.go ErrTxInCache."""
 
@@ -77,6 +110,7 @@ class MempoolTx:
     gas_wanted: int
     senders: set  # peer ids that sent us this tx (mempoolIDs analogue)
     seq: int = 0  # monotone insertion sequence (clist-iteration analogue)
+    priority: int = 0  # QoS rank: reap high-first, evict low-first
 
 
 class TxCache:
@@ -141,20 +175,38 @@ class Mempool:
         self.post_check = None
         self.log = get_logger("mempool")
         from .libs.metrics import MempoolMetrics
+        from .libs.tracing import NOP as _NOP_RECORDER
 
         self.metrics = MempoolMetrics()  # nop; node swaps in prometheus
+        self.recorder = _NOP_RECORDER  # node swaps in its flight recorder
+        self.wal_size_limit = cfg.get("wal_size_limit", 16 * 1024 * 1024)
         self._wal = None  # optional tx journal (clist_mempool.go InitWAL)
 
     # -- WAL (clist_mempool.go:137) ----------------------------------------
-    def init_wal(self, wal_dir: str) -> None:
-        """Append every accepted tx to `<wal_dir>/wal` — an operator-grade
-        journal of what entered the mempool (the reference writes the raw
-        tx + newline; here length-prefixed hex lines so binary txs with
-        newlines survive a round-trip)."""
+    def init_wal(self, wal_dir: str, size_limit: Optional[int] = None) -> None:
+        """Append every accepted tx to a size-capped rotating journal
+        under `<wal_dir>/wal` — operator-grade record of what entered the
+        mempool (the reference writes the raw tx + newline; here hex lines
+        so binary txs with newlines survive a round-trip).
+
+        Rotation reuses the consensus WAL's substrate (libs/autofile.Group,
+        the head-size-limit pattern): the head rotates into numbered
+        chunks and the OLDEST chunks are deleted past `size_limit` total —
+        under a sustained ingress firehose the journal is bounded instead
+        of growing without limit."""
         import os
 
+        from .libs.autofile import Group
+
+        limit = self.wal_size_limit if size_limit is None else size_limit
         os.makedirs(wal_dir, exist_ok=True)
-        self._wal = open(os.path.join(wal_dir, "wal"), "ab")
+        self._wal = Group(
+            os.path.join(wal_dir, "wal"),
+            # several chunks inside the total bound so rotation sheds old
+            # entries gradually, not half the journal at once
+            head_size_limit=max(4096, limit // 8),
+            group_size_limit=limit,
+        )
 
     def close_wal(self) -> None:
         if self._wal is not None:
@@ -166,8 +218,23 @@ class Mempool:
             try:
                 self._wal.write(tx.hex().encode() + b"\n")
                 self._wal.flush()
+                self._wal.maybe_rotate()
             except OSError as e:
                 self.log.error("mempool wal write failed", err=str(e))
+
+    def wal_txs(self) -> List[bytes]:
+        """Replay the retained journal (oldest chunk through head).  A
+        torn tail line (crash mid-write) ends the replay cleanly, like the
+        consensus WAL's torn-record handling."""
+        if self._wal is None:
+            return []
+        out: List[bytes] = []
+        for line in self._wal.read_all().splitlines():
+            try:
+                out.append(bytes.fromhex(line.decode()))
+            except (ValueError, UnicodeDecodeError):
+                break  # torn tail write: everything before it is intact
+        return out
 
     # -- locking (commit window) ------------------------------------------
     def lock(self):
@@ -191,48 +258,94 @@ class Mempool:
             self._tx_available.set()
 
     # -- ingress -----------------------------------------------------------
+    #
+    # Admission pipeline, CHEAPEST FIRST (the QoS invariant: pre-rejected
+    # garbage never buys a signature verify, let alone an app round-trip):
+    #
+    #   1. structural   size cap; envelope shape when sig_precheck is on
+    #   2. dedup        cache hit rejects free (and records the sender)
+    #   3. admission    full pool must be displaceable by this priority
+    #   4. sig verify   batched through the shared engine
+    #   5. app CheckTx  the ABCI round-trip
+    #
+    # Eviction (step 3 realized): a full pool throws out its LOWEST-
+    # priority txs to admit a strictly better one — MempoolFullError is
+    # reserved for txs that cannot displace anything.
+
     async def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
-        """CheckTx (clist_mempool.go:213): cache-dedup, app CheckTx, add.
-        Raises on structural rejection; returns the app response (which may
-        itself carry a non-OK code)."""
+        """CheckTx (clist_mempool.go:213): structural checks, cache-dedup,
+        admission, sig precheck, app CheckTx, add.  Raises on rejection;
+        returns the app response (which may itself carry a non-OK code)."""
+        # 1. structural: a few byte compares before anything costs
         if len(tx) > self.max_tx_bytes:
+            self.metrics.failed_txs.inc()
             raise MempoolError(f"tx too large: {len(tx)} > {self.max_tx_bytes}")
-        if len(self.txs) >= self.size_limit or self.txs_bytes + len(tx) > self.max_txs_bytes:
-            raise MempoolFullError(len(self.txs), self.txs_bytes)
-        if self.pre_check is not None:
-            err = self.pre_check(tx)
-            if err:
-                raise MempoolError(f"pre-check failed: {err}")
-        if (
-            self.sig_precheck
-            and tx.startswith(SIGNED_TX_PREFIX)
-            # a cached tx was already verified (or is a tracked invalid):
-            # re-verifying every gossiped duplicate would invert the
-            # feature's point — let the cache-dedup below reject it free
-            and not self.cache.contains(tx)
-        ):
-            # BEFORE the app round-trip — rejecting here is what lets the
-            # engine batch a burst of envelopes in one flush
-            if not await self._verify_tx_sig(tx):
-                # cache the rejection: the key is the hash of the FULL tx
-                # bytes (pubkey+sig+payload), so these exact bytes can
-                # never become valid — without this, resubmitting the same
-                # bad envelope buys a fresh verify every time
+        envelope = None
+        if self.sig_precheck and tx.startswith(SIGNED_TX_PREFIX):
+            envelope = parse_signed_tx(tx)
+            if envelope is None:
+                # carries the prefix but is structurally broken: cache the
+                # rejection — these exact bytes can never become valid, so
+                # resubmission must stay free
                 self.cache.push(tx)
                 self.metrics.failed_txs.inc()
-                raise MempoolError("invalid tx signature")
+                raise MempoolError("malformed signed-tx envelope")
+        # 2. dedup BEFORE any signature work: every gossiped duplicate
+        # (and every resubmitted known-bad envelope) rejects here free
         if not self.cache.push(tx):
             # record the new sender for an existing tx (clist_mempool.go:239)
             existing = self.txs.get(tx_hash(tx))
             if existing is not None and sender:
                 existing.senders.add(sender)
             raise TxInCacheError()
+        priority = tx_priority(tx)
+        try:
+            if self.pre_check is not None:
+                err = self.pre_check(tx)
+                if err:
+                    raise MempoolError(f"pre-check failed: {err}")
+            # 3. admission: would this tx displace enough lower-priority
+            # bytes?  Decided BEFORE the verify so a flood of low-priority
+            # txs against a full pool never reaches the engine.
+            self._admission_check(len(tx), priority)
+        except MempoolError:
+            # state-dependent rejection (pool may drain, params may
+            # change): do NOT poison the cache for these bytes
+            self.cache.remove(tx)
+            self.metrics.failed_txs.inc()
+            raise
+        # 4. signature precheck, batched through the shared engine —
+        # rejecting before the app round-trip is what lets a burst of
+        # envelopes coalesce into one flush
+        if envelope is not None:
+            if not await self._verify_tx_sig(envelope):
+                # keep cached: the key is the hash of the FULL tx bytes
+                # (pubkey+sig+payload), so these exact bytes can never
+                # become valid — resubmission must not buy a fresh verify
+                self.metrics.failed_txs.inc()
+                raise MempoolError("invalid tx signature")
 
+        # 5. the app round-trip
         res = await self.proxy_app.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CheckTxType.NEW))
         if res.code == abci.CODE_TYPE_OK:
+            # A NONZERO app priority overrides the fee-declared one; 0 is
+            # indistinguishable from "app is priority-unaware" (the int
+            # default), so the client fee survives it as a floor — an app
+            # that wants to demote a tx outright rejects it (code != 0)
+            priority = getattr(res, "priority", 0) or priority
+            # re-run admission against the pool as it stands NOW (the
+            # verify/app awaits may have admitted competitors), this time
+            # actually evicting the displaced txs
+            try:
+                self._make_room(len(tx), priority)
+            except MempoolFullError:
+                self.cache.remove(tx)
+                self.metrics.failed_txs.inc()
+                raise
             self._seq += 1
             mtx = MempoolTx(
-                tx=tx, height=self.height, gas_wanted=res.gas_wanted, senders=set(), seq=self._seq
+                tx=tx, height=self.height, gas_wanted=res.gas_wanted, senders=set(),
+                seq=self._seq, priority=priority,
             )
             if sender:
                 mtx.senders.add(sender)
@@ -252,10 +365,77 @@ class Mempool:
             self.log.debug("rejected bad transaction", tx=tx_hash(tx).hex()[:16], code=res.code)
         return res
 
-    async def _verify_tx_sig(self, tx: bytes) -> bool:
-        parsed = parse_signed_tx(tx)
-        if parsed is None:
-            return False  # carries the prefix but is structurally broken
+    def _is_full(self, tx_len: int) -> bool:
+        return (
+            len(self.txs) >= self.size_limit
+            or self.txs_bytes + tx_len > self.max_txs_bytes
+        )
+
+    def _eviction_order(self) -> List[MempoolTx]:
+        """Victims worst-first: lowest priority, then newest (an older tx
+        of equal priority has waited longer and keeps its place)."""
+        return sorted(self.txs.values(), key=lambda m: (m.priority, -m.seq))
+
+    def _admission_check(self, tx_len: int, priority: int) -> None:
+        """Raise MempoolFullError unless the pool has room or strictly
+        lower-priority txs could be evicted to make it.  Read-only — the
+        actual eviction happens in _make_room after the app accepts."""
+        if not self._is_full(tx_len):
+            return
+        freeable = 0
+        count = 0
+        for mtx in self._eviction_order():
+            if mtx.priority >= priority:
+                break
+            freeable += len(mtx.tx)
+            count += 1
+            if (
+                len(self.txs) - count < self.size_limit
+                and self.txs_bytes - freeable + tx_len <= self.max_txs_bytes
+            ):
+                return
+        raise MempoolFullError(len(self.txs), self.txs_bytes)
+
+    def _make_room(self, tx_len: int, priority: int) -> None:
+        """Evict lowest-priority txs until the pool can hold `tx_len` more
+        bytes + one more entry.  The eviction set is computed FIRST from
+        one sorted walk (the _admission_check shape): when only equal-or-
+        higher-priority txs stand in the way this raises MempoolFullError
+        having evicted NOTHING — a rejection must never also drop valid
+        txs the pool promised to keep."""
+        if not self._is_full(tx_len):
+            return
+        victims: List[MempoolTx] = []
+        freed = 0
+        for mtx in self._eviction_order():
+            if mtx.priority >= priority:
+                raise MempoolFullError(len(self.txs), self.txs_bytes)
+            victims.append(mtx)
+            freed += len(mtx.tx)
+            if (
+                len(self.txs) - len(victims) < self.size_limit
+                and self.txs_bytes - freed + tx_len <= self.max_txs_bytes
+            ):
+                break
+        else:
+            raise MempoolFullError(len(self.txs), self.txs_bytes)
+        for victim in victims:
+            self.txs.pop(tx_hash(victim.tx), None)
+            self.txs_bytes -= len(victim.tx)
+            # let the evicted tx re-enter later (it was valid, just outbid)
+            self.cache.remove(victim.tx)
+            self.metrics.priority_evicted.inc()
+            self.metrics.priority_floor.set(victim.priority)
+        if victims:
+            self.recorder.record(
+                "ingress.evict", n=len(victims), priority=priority, size=len(self.txs)
+            )
+            self.metrics.size.set(len(self.txs))
+            self.log.debug(
+                "evicted lower-priority txs", n=len(victims), for_priority=priority
+            )
+
+    async def _verify_tx_sig(self, parsed: tuple) -> bool:
         pubkey, sign_bytes, sig, _ = parsed
         if self.sig_verifier is not None:
             try:
@@ -268,11 +448,13 @@ class Mempool:
 
     # -- egress ------------------------------------------------------------
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
-        """clist_mempool.go:471."""
+        """clist_mempool.go:471, priority-ordered: the block drains the
+        HIGHEST-priority txs first (ties broken by arrival seq, so an
+        all-default-priority pool reaps in the reference's FIFO order)."""
         total_bytes = 0
         total_gas = 0
         out = []
-        for mtx in self.txs.values():
+        for mtx in sorted(self.txs.values(), key=lambda m: (-m.priority, m.seq)):
             nb = total_bytes + len(mtx.tx) + 8  # conservative framing overhead
             if max_bytes > -1 and nb > max_bytes:
                 break

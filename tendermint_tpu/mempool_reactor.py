@@ -1,8 +1,14 @@
-"""Mempool reactor: tx gossip.
+"""Mempool reactor: tx gossip with per-peer flowrate pacing.
 
 Reference parity: mempool/reactor.go (channel 0x30:20,
 broadcastTxRoutine:188 walking the clist per peer and skipping the
 originating sender, Receive:157 feeding CheckTx).
+
+QoS (overload robustness): outbound tx frames to each peer are capped at
+`mempool.broadcast_batch_bytes` and token-bucket paced to
+`mempool.broadcast_rate_bytes` bytes/sec (libs/flowrate.TokenBucket), so
+an ingress firehose fans out as a bounded stream per link instead of
+saturating every peer connection ahead of consensus traffic.
 """
 
 from __future__ import annotations
@@ -11,6 +17,7 @@ import asyncio
 from typing import List
 
 from .encoding import codec
+from .libs.flowrate import TokenBucket
 from .libs.log import get_logger
 from .mempool import Mempool, MempoolError
 from .p2p import ChannelDescriptor, Reactor
@@ -18,11 +25,32 @@ from .p2p import ChannelDescriptor, Reactor
 MEMPOOL_CHANNEL = 0x30
 
 
+def chunk_txs(txs: List[bytes], max_bytes: int) -> List[List[bytes]]:
+    """Split a tx list into frames of <= max_bytes payload each (one
+    oversized tx still rides alone — the mempool's max_tx_bytes bounds
+    it).  Pure so the framing policy is testable without a peer."""
+    frames: List[List[bytes]] = []
+    cur: List[bytes] = []
+    cur_bytes = 0
+    for tx in txs:
+        if cur and cur_bytes + len(tx) > max_bytes:
+            frames.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(tx)
+        cur_bytes += len(tx)
+    if cur:
+        frames.append(cur)
+    return frames
+
+
 class MempoolReactor(Reactor):
-    def __init__(self, mempool: Mempool, broadcast: bool = True):
+    def __init__(self, mempool: Mempool, broadcast: bool = True, config=None):
         super().__init__("mempool-reactor")
+        cfg = config or {}
         self.mempool = mempool
         self.broadcast = broadcast
+        self.rate_bytes = cfg.get("broadcast_rate_bytes", 0)
+        self.batch_bytes = cfg.get("broadcast_batch_bytes", 65536)
         self.log = get_logger("mempool-reactor")
         self._routines = {}
 
@@ -55,7 +83,14 @@ class MempoolReactor(Reactor):
 
     async def _broadcast_tx_routine(self, peer) -> None:
         """reactor.go:188 — stream mempool txs to the peer, skipping txs it
-        sent us."""
+        sent us.  Frames are byte-capped and paced by a per-peer token
+        bucket (debit discipline: a frame larger than the burst spreads
+        out instead of never qualifying)."""
+        bucket = (
+            TokenBucket(self.rate_bytes, 2 * self.rate_bytes)
+            if self.rate_bytes > 0
+            else None
+        )
         seq = 0
         while True:
             mtxs = await self.mempool.next_txs_after(seq)
@@ -65,8 +100,13 @@ class MempoolReactor(Reactor):
                 if peer.id in mtx.senders:
                     continue
                 batch.append(mtx.tx)
-            if batch:
-                ok = await peer.send(MEMPOOL_CHANNEL, codec.dumps({"txs": batch}))
+            for frame in chunk_txs(batch, self.batch_bytes):
+                data = codec.dumps({"txs": frame})
+                if bucket is not None:
+                    wait = bucket.debit(len(data))
+                    if wait > 0:
+                        await asyncio.sleep(wait)
+                ok = await peer.send(MEMPOOL_CHANNEL, data)
                 if not ok:
                     return
             await asyncio.sleep(0.01)

@@ -257,6 +257,7 @@ class Node(Service):
         self.evidence_pool.metrics.pending.set(self.evidence_pool.num_pending())
 
         self.mempool.metrics = self.metrics_provider.mempool
+        self.mempool.recorder = self.flight_recorder
 
         block_exec = BlockExecutor(
             self.state_store,
@@ -305,6 +306,10 @@ class Node(Service):
             from .rpc.server import RPCServer
 
             self.rpc_server = RPCServer(self, cfg.rpc)
+            # ingress admission-control telemetry rides the node's own
+            # metrics registry + flight recorder (ingress.throttle events)
+            self.rpc_server.core.metrics = self.metrics_provider.rpc
+            self.rpc_server.core.recorder = self.flight_recorder
             await self.rpc_server.start()
             self.log.info("rpc listening", laddr=cfg.rpc.laddr)
         if cfg.rpc.grpc_laddr:
@@ -453,7 +458,12 @@ class Node(Service):
             # always registered — broadcast=false only disables outbound
             # gossip, inbound txs must still be accepted (mempool/reactor.go)
             self.switch.add_reactor(
-                "MEMPOOL", MempoolReactor(self.mempool, broadcast=cfg.mempool.broadcast)
+                "MEMPOOL",
+                MempoolReactor(
+                    self.mempool,
+                    broadcast=cfg.mempool.broadcast,
+                    config=cfg.mempool.as_dict(),
+                ),
             )
             self.switch.add_reactor("EVIDENCE", EvidenceReactor(self.evidence_pool))
             # PEX + address book: peer discovery (node/node.go:381 createPEXReactor)

@@ -10,14 +10,23 @@ and the in-proc LocalClient both dispatch through `call()`.
 from __future__ import annotations
 
 import asyncio
+import collections
 import time
 from typing import Any, Dict, Optional
 
 from ..abci.types import RequestInfo, RequestQuery
+from ..libs.flowrate import TokenBucket
 from ..libs.log import get_logger
+from ..mempool import MempoolFullError
 from ..types.events import EVENT_TX, EVENT_TYPE_KEY, TX_HASH_KEY
 from ..types.tx import tx_hash
-from .jsonrpc import INTERNAL_ERROR, INVALID_PARAMS, METHOD_NOT_FOUND, RPCError
+from .jsonrpc import (
+    INTERNAL_ERROR,
+    INVALID_PARAMS,
+    METHOD_NOT_FOUND,
+    RPCError,
+    overloaded_error,
+)
 
 _MAX_PER_PAGE = 100
 
@@ -90,10 +99,41 @@ class RPCCore:
         "unsafe_chaos_status",
     }
 
-    def __init__(self, node, unsafe: bool = False, timeout_broadcast_tx_commit: float = 10.0):
+    #: broadcast routes gated by ingress admission control
+    BROADCAST_ROUTES = frozenset(
+        {"broadcast_tx_async", "broadcast_tx_sync", "broadcast_tx_commit"}
+    )
+    #: bound on distinct per-source rate-limit buckets kept live (LRU);
+    #: an address-spraying client recycles buckets instead of growing maps
+    MAX_SOURCES = 1024
+
+    def __init__(
+        self,
+        node,
+        unsafe: bool = False,
+        timeout_broadcast_tx_commit: float = 10.0,
+        broadcast_rate: float = 0.0,
+        broadcast_rate_burst: int = 200,
+        max_broadcast_inflight: int = 1024,
+        max_commit_waiters: int = 64,
+    ):
         self.node = node
         self.unsafe = unsafe
         self.timeout_broadcast_tx_commit = timeout_broadcast_tx_commit
+        # ingress admission control (defaults mirror config.RPCConfig so a
+        # bare core — the gRPC broadcast API builds one — is still bounded)
+        self.broadcast_rate = broadcast_rate
+        self.broadcast_rate_burst = broadcast_rate_burst
+        self.max_broadcast_inflight = max_broadcast_inflight
+        self.max_commit_waiters = max_commit_waiters
+        self._buckets: "collections.OrderedDict[str, TokenBucket]" = collections.OrderedDict()
+        self._inflight = 0
+        self._commit_waiters = 0
+        from ..libs.metrics import RPCMetrics
+        from ..libs.tracing import NOP as _NOP_RECORDER
+
+        self.metrics = RPCMetrics()  # nop; node swaps in prometheus
+        self.recorder = _NOP_RECORDER  # node swaps in its flight recorder
         self.log = get_logger("rpc")
         self._sub_seq = 0
         self._hints: Dict[str, Dict[str, Any]] = {}
@@ -137,11 +177,18 @@ class RPCCore:
             out[k] = v
         return out
 
-    async def call(self, method: str, params: Optional[Dict[str, Any]] = None) -> Any:
+    async def call(
+        self, method: str, params: Optional[Dict[str, Any]] = None, source: str = ""
+    ) -> Any:
+        """`source` identifies the requesting client (remote address for
+        HTTP/WS; empty for trusted in-proc callers) — the key admission
+        control rate-limits broadcast routes by."""
         if method not in self.ROUTES:
             raise RPCError(METHOD_NOT_FOUND, f"unknown method {method!r}")
         if method in self.UNSAFE and not self.unsafe:
             raise RPCError(METHOD_NOT_FOUND, f"{method} requires rpc.unsafe=true")
+        if method in self.BROADCAST_ROUTES:
+            self._throttle_broadcast(source)
         handler = getattr(self, method)
         try:
             return await handler(**self._coerce(method, handler, params or {}))
@@ -152,6 +199,49 @@ class RPCCore:
         except Exception as e:  # noqa: BLE001 — the API boundary
             self.log.error("rpc handler error", method=method, err=repr(e))
             raise RPCError(INTERNAL_ERROR, repr(e))
+
+    # -- ingress admission control ----------------------------------------
+
+    def _throttle_broadcast(self, source: str) -> None:
+        """Per-source token bucket over the broadcast routes.  A source-
+        less call (in-proc LocalClient, tests) is trusted — the global
+        in-flight bound below still applies to its work."""
+        if self.broadcast_rate <= 0 or not source:
+            return
+        bucket = self._buckets.get(source)
+        if bucket is None:
+            if len(self._buckets) >= self.MAX_SOURCES:
+                self._buckets.popitem(last=False)
+            bucket = TokenBucket(self.broadcast_rate, self.broadcast_rate_burst)
+            self._buckets[source] = bucket
+        else:
+            self._buckets.move_to_end(source)
+        if not bucket.allow():
+            retry = bucket.retry_after()
+            self.metrics.throttled.labels(reason="rate").inc()
+            self.recorder.record_sampled("ingress.throttle", reason="rate", source=source)
+            raise overloaded_error(
+                f"per-source broadcast rate limit ({self.broadcast_rate:g} tx/s) exceeded",
+                retry,
+            )
+
+    def _acquire_inflight(self) -> None:
+        """Claim a slot in the bounded in-flight broadcast queue; reject —
+        never queue silently — when it is full."""
+        if 0 < self.max_broadcast_inflight <= self._inflight:
+            self.metrics.throttled.labels(reason="inflight").inc()
+            self.recorder.record_sampled("ingress.throttle", reason="inflight")
+            raise overloaded_error(
+                f"{self._inflight} broadcasts in flight (cap "
+                f"{self.max_broadcast_inflight})",
+                0.1,
+            )
+        self._inflight += 1
+        self.metrics.broadcast_inflight.set(self._inflight)
+
+    def _release_inflight(self) -> None:
+        self._inflight -= 1
+        self.metrics.broadcast_inflight.set(self._inflight)
 
     # -- info routes -------------------------------------------------------
 
@@ -454,13 +544,39 @@ class RPCCore:
         return {"n_txs": self.node.mempool.size(), "total": self.node.mempool.size()}
 
     async def broadcast_tx_async(self, tx: bytes) -> dict:
-        """rpc/core/mempool.go:22 — fire and forget."""
-        asyncio.ensure_future(self.node.mempool.check_tx(tx))
+        """rpc/core/mempool.go:22 — fire and forget, but BOUNDED: the
+        CheckTx work claims an in-flight slot (released when it finishes)
+        so a firehose of async broadcasts queues explicit rejections, not
+        unbounded tasks."""
+        self._acquire_inflight()
+        task = asyncio.ensure_future(self.node.mempool.check_tx(tx))
+
+        def _done(t: asyncio.Task) -> None:
+            self._release_inflight()
+            if t.cancelled():
+                return
+            # rejections are expected fire-and-forget outcomes, but the
+            # shedding ones must still be OBSERVABLE — async mode gave the
+            # client code 0 up front, so telemetry is the only signal left
+            exc = t.exception()
+            if isinstance(exc, MempoolFullError):
+                self.metrics.throttled.labels(reason="mempool_full").inc()
+                self.recorder.record_sampled("ingress.throttle", reason="mempool_full")
+
+        task.add_done_callback(_done)
         return {"code": 0, "data": b"", "log": "", "hash": tx_hash(tx)}
 
     async def broadcast_tx_sync(self, tx: bytes) -> dict:
         """rpc/core/mempool.go:36 — wait for CheckTx."""
-        res = await self.node.mempool.check_tx(tx)
+        self._acquire_inflight()
+        try:
+            res = await self.node.mempool.check_tx(tx)
+        except MempoolFullError as e:
+            self.metrics.throttled.labels(reason="mempool_full").inc()
+            self.recorder.record_sampled("ingress.throttle", reason="mempool_full")
+            raise overloaded_error(str(e), 1.0)
+        finally:
+            self._release_inflight()
         return {
             "code": res.code,
             "data": res.data,
@@ -471,7 +587,27 @@ class RPCCore:
     async def broadcast_tx_commit(self, tx: bytes) -> dict:
         """rpc/core/mempool.go:56 — CheckTx, then wait for the DeliverTx
         event via an EventBus subscription (the reference flow verbatim:
-        subscribe first so the commit can't race the wait)."""
+        subscribe first so the commit can't race the wait).  Concurrent
+        waiters are CAPPED: each holds an event-bus subscription for up to
+        timeout_broadcast_tx_commit, so under a commit stall an uncapped
+        route would pile subscriptions onto the bus without bound."""
+        if 0 < self.max_commit_waiters <= self._commit_waiters:
+            self.metrics.throttled.labels(reason="commit_waiters").inc()
+            self.recorder.record_sampled("ingress.throttle", reason="commit_waiters")
+            raise overloaded_error(
+                f"{self._commit_waiters} broadcast_tx_commit waiters (cap "
+                f"{self.max_commit_waiters})",
+                self.timeout_broadcast_tx_commit,
+            )
+        self._commit_waiters += 1
+        self.metrics.commit_waiters.set(self._commit_waiters)
+        try:
+            return await self._broadcast_tx_commit(tx)
+        finally:
+            self._commit_waiters -= 1
+            self.metrics.commit_waiters.set(self._commit_waiters)
+
+    async def _broadcast_tx_commit(self, tx: bytes) -> dict:
         bus = self.node.event_bus
         h = tx_hash(tx)
         self._sub_seq += 1
@@ -479,7 +615,15 @@ class RPCCore:
         q = f"{EVENT_TYPE_KEY}='{EVENT_TX}' AND {TX_HASH_KEY}='{h.hex().upper()}'"
         sub = await bus.subscribe(subscriber, q)
         try:
-            check = await self.node.mempool.check_tx(tx)
+            self._acquire_inflight()
+            try:
+                check = await self.node.mempool.check_tx(tx)
+            except MempoolFullError as e:
+                self.metrics.throttled.labels(reason="mempool_full").inc()
+                self.recorder.record_sampled("ingress.throttle", reason="mempool_full")
+                raise overloaded_error(str(e), 1.0)
+            finally:
+                self._release_inflight()
             if check.code != 0:
                 return {
                     "check_tx": check,

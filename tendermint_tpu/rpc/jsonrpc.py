@@ -21,10 +21,27 @@ INVALID_REQUEST = -32600
 METHOD_NOT_FOUND = -32601
 INVALID_PARAMS = -32602
 INTERNAL_ERROR = -32603
+# Server-defined (-32000..-32099 range): the node is shedding load.  The
+# error's `data` is a JSON OBJECT (not a string) carrying `retry_after`
+# seconds — the explicit backoff hint admission control promises clients
+# instead of silent queueing (rate limit hit, broadcast queue full,
+# mempool full, commit-waiter cap reached).
+SERVER_OVERLOADED = -32005
+
+
+def overloaded_error(message: str, retry_after: float) -> "RPCError":
+    """The one constructor for overload rejections, so every shedding
+    path carries the same machine-readable retry_after hint."""
+    return RPCError(
+        SERVER_OVERLOADED, message,
+        data={"retry_after": round(max(retry_after, 0.0), 3)},
+    )
 
 
 class RPCError(Exception):
-    def __init__(self, code: int, message: str, data: str = ""):
+    # `data` is any JSON-able value per the JSON-RPC 2.0 spec (overload
+    # errors carry {"retry_after": s}); "" when absent
+    def __init__(self, code: int, message: str, data=""):
         super().__init__(message)
         self.code = code
         self.message = message

@@ -67,6 +67,10 @@ class RPCServer(Service):
             node,
             unsafe=rpc_cfg.unsafe,
             timeout_broadcast_tx_commit=rpc_cfg.timeout_broadcast_tx_commit,
+            broadcast_rate=rpc_cfg.broadcast_rate,
+            broadcast_rate_burst=rpc_cfg.broadcast_rate_burst,
+            max_broadcast_inflight=rpc_cfg.max_broadcast_inflight,
+            max_commit_waiters=rpc_cfg.max_commit_waiters,
         )
         self.log = get_logger("rpc.server")
         self._runner: Optional[web.AppRunner] = None
@@ -103,18 +107,53 @@ class RPCServer(Service):
     # -- HTTP POST: JSON-RPC (single or batch) ----------------------------
 
     async def _handle_post(self, request: web.Request) -> web.Response:
+        # Bounded read BEFORE parsing (http_server.go maxBodyBytes): the
+        # raw content stream is read up to max_body_bytes + 1 total — in a
+        # loop, because StreamReader.read(n) returns whatever chunk is
+        # buffered, not n bytes — so a client streaming an arbitrarily
+        # large body can never reach json.loads; it gets an explicit
+        # rejection after one bounded buffer.
+        limit = self.cfg.max_body_bytes
+        body = b""
+        while len(body) <= limit:
+            chunk = await request.content.read(limit + 1 - len(body))
+            if not chunk:
+                break
+            body += chunk
+        if len(body) > limit:
+            return web.json_response(
+                make_response(
+                    None,
+                    error=RPCError(
+                        INVALID_REQUEST, f"request body exceeds {limit} bytes"
+                    ),
+                )
+            )
         try:
-            payload = json.loads(await request.read())
+            payload = json.loads(body)
         except (ValueError, UnicodeDecodeError):
             return web.json_response(
                 make_response(None, error=RPCError(PARSE_ERROR, "invalid JSON"))
             )
+        source = request.remote or ""
         if isinstance(payload, list):  # batch (http_json_handler.go:66)
-            out = await asyncio.gather(*(self._dispatch(r) for r in payload))
+            if len(payload) > self.cfg.max_batch_request_items:
+                # one POST must not fan out into thousands of handler tasks
+                return web.json_response(
+                    make_response(
+                        None,
+                        error=RPCError(
+                            INVALID_REQUEST,
+                            f"batch of {len(payload)} exceeds "
+                            f"{self.cfg.max_batch_request_items} requests",
+                        ),
+                    )
+                )
+            out = await asyncio.gather(*(self._dispatch(r, source) for r in payload))
             return web.json_response(out)
-        return web.json_response(await self._dispatch(payload))
+        return web.json_response(await self._dispatch(payload, source))
 
-    async def _dispatch(self, req: Any) -> dict:
+    async def _dispatch(self, req: Any, source: str = "") -> dict:
         if not isinstance(req, dict) or "method" not in req:
             return make_response(None, error=RPCError(INVALID_REQUEST, "malformed request"))
         req_id = req.get("id")
@@ -132,7 +171,7 @@ class RPCServer(Service):
                 ),
             )
         try:
-            result = await self.core.call(method, params)
+            result = await self.core.call(method, params, source=source)
             return make_response(req_id, result)
         except RPCError as e:
             return make_response(req_id, error=e)
@@ -154,7 +193,7 @@ class RPCServer(Service):
                 make_response(-1, error=RPCError(METHOD_NOT_FOUND, "use /websocket"))
             )
         try:
-            result = await self.core.call(method, params)
+            result = await self.core.call(method, params, source=request.remote or "")
             return web.json_response(make_response(-1, result))
         except RPCError as e:
             return web.json_response(make_response(-1, error=e))
@@ -167,11 +206,17 @@ class RPCServer(Service):
             and len(self._ws_clients) >= self.cfg.max_subscription_clients
         ):
             raise web.HTTPServiceUnavailable(text="max subscription clients reached")
-        ws = web.WebSocketResponse()
+        ws = web.WebSocketResponse(
+            # frame-size bound on the receive path: a client must not be
+            # able to stream an arbitrarily large text frame into
+            # json.loads below (same budget as the HTTP body cap)
+            max_msg_size=self.cfg.max_body_bytes,
+        )
         await ws.prepare(request)
         self._ws_clients.add(ws)
         self._ws_seq += 1
         subscriber = f"ws-{self._ws_seq}"
+        source = request.remote or subscriber
         # query string -> pump task streaming matching events to this client
         subs: dict[str, asyncio.Task] = {}
         try:
@@ -185,7 +230,7 @@ class RPCServer(Service):
                         make_response(None, error=RPCError(PARSE_ERROR, "invalid JSON"))
                     )
                     continue
-                await self._ws_dispatch(ws, subscriber, subs, req)
+                await self._ws_dispatch(ws, subscriber, subs, req, source)
         finally:
             for task in subs.values():
                 task.cancel()
@@ -193,7 +238,9 @@ class RPCServer(Service):
             self._ws_clients.discard(ws)
         return ws
 
-    async def _ws_dispatch(self, ws, subscriber: str, subs: dict, req: Any) -> None:
+    async def _ws_dispatch(
+        self, ws, subscriber: str, subs: dict, req: Any, source: str = ""
+    ) -> None:
         if not isinstance(req, dict) or "method" not in req:
             await ws.send_json(
                 make_response(None, error=RPCError(INVALID_REQUEST, "malformed request"))
@@ -229,7 +276,9 @@ class RPCServer(Service):
                 await self.node.event_bus.unsubscribe_all(subscriber)
                 await ws.send_json(make_response(req_id, {}))
             else:
-                result = await self.core.call(method, params if isinstance(params, dict) else {})
+                result = await self.core.call(
+                    method, params if isinstance(params, dict) else {}, source=source
+                )
                 await ws.send_json(make_response(req_id, result))
         except RPCError as e:
             try:
@@ -239,7 +288,11 @@ class RPCServer(Service):
 
     async def _pump(self, ws, req_id, query: str, sub) -> None:
         """Stream matching events to the client as JSON-RPC notifications
-        (ws_handler.go: id = original id + '#event')."""
+        (ws_handler.go: id = original id + '#event').  A subscriber that
+        stops draining gets its subscription cancelled by the bus
+        (ErrOutOfCapacity flavor) — tell it so explicitly instead of going
+        silent: the fan-out limit that keeps one hot client from stalling
+        the bus must never look like a quiet stream."""
         try:
             async for msg in sub:
                 await ws.send_json(
@@ -250,6 +303,16 @@ class RPCServer(Service):
                             "data": {"type": msg.data.type, "value": msg.data.data},
                             "events": msg.events,
                         },
+                    )
+                )
+            if getattr(sub, "cancelled", False):
+                await ws.send_json(
+                    make_response(
+                        f"{req_id}#event",
+                        error=RPCError(
+                            INTERNAL_ERROR,
+                            f"subscription cancelled: {sub.cancel_reason}",
+                        ),
                     )
                 )
         except (ConnectionError, asyncio.CancelledError):

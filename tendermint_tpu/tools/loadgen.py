@@ -1,0 +1,286 @@
+"""Tx-ingress load generator (tm-bench parity).
+
+Drives signed-tx envelopes (mempool.make_signed_tx) at the JSON-RPC
+broadcast endpoints across many concurrent connections at a configurable
+rate/size, and reports the numbers the overload layer is judged by:
+
+  - offered vs accepted vs rejected tx/sec (the acceptance split), with
+    every rejection CLASSIFIED: `throttled` = explicit SERVER_OVERLOADED
+    errors (rate limit / in-flight cap / mempool full — the admission
+    contract), `rejected` = app- or mempool-level refusals, `transport` =
+    connection errors/timeouts (silent drops; a healthy overloaded node
+    should produce ~none);
+  - commit-latency-under-load percentiles, measured from the TARGET
+    node's flight recorder (`dump_flight_recorder` `step` events): the
+    wall milliseconds between consecutive Commit steps while the firehose
+    runs — the consensus-keeps-committing number, from the same
+    instrumentation production telemetry uses.
+
+Programmatic entry: `await run_load(targets, ...)` (networks/local/
+load_smoke.py composes it with the chaos invariant checker); CLI:
+
+    python -m tendermint_tpu.tools.loadgen 127.0.0.1:26657 \
+        --connections 16 --duration 10 --rate 0 --mode sync --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import base64
+import json
+import time
+from typing import Dict, List, Optional
+
+import aiohttp
+
+from ..crypto.keys import Ed25519PrivKey
+from ..mempool import make_signed_tx
+from ..rpc.jsonrpc import SERVER_OVERLOADED
+
+
+def _base_url(target: str) -> str:
+    target = target.split("://")[-1]
+    return f"http://{target}"
+
+
+def percentiles(xs: List[float], ps=(50, 90, 99)) -> Dict[str, float]:
+    if not xs:
+        return {f"p{p}": -1.0 for p in ps}
+    xs = sorted(xs)
+    out = {}
+    for p in ps:
+        i = min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))
+        out[f"p{p}"] = round(xs[i], 1)
+    return out
+
+
+class Counters:
+    __slots__ = ("offered", "accepted", "rejected", "throttled", "transport",
+                 "retry_after_seen", "codes")
+
+    def __init__(self):
+        self.offered = 0
+        self.accepted = 0
+        self.rejected = 0
+        self.throttled = 0
+        self.transport = 0
+        self.retry_after_seen = 0
+        self.codes: Dict[str, int] = {}
+
+    def as_dict(self) -> dict:
+        return {
+            "offered": self.offered,
+            "accepted": self.accepted,
+            "rejected": self.rejected,
+            "throttled": self.throttled,
+            "transport_errors": self.transport,
+            "retry_after_seen": self.retry_after_seen,
+            "reject_codes": dict(self.codes),
+        }
+
+
+def make_tx(key: Ed25519PrivKey, worker: int, seq: int, tx_bytes: int,
+            fee: int = 0, signed: bool = True) -> bytes:
+    """A unique kvstore payload padded to ~tx_bytes, optionally carrying a
+    fee:<n>: priority prefix, wrapped in a signed envelope."""
+    prefix = b"fee:%d:" % fee if fee > 0 else b""
+    head = prefix + b"ld%d.%d=" % (worker, seq)
+    pad = max(1, tx_bytes - len(head) - (102 if signed else 0))
+    payload = head + b"x" * pad
+    return make_signed_tx(key, payload) if signed else payload
+
+
+async def _worker(
+    wid: int,
+    session: aiohttp.ClientSession,
+    targets: List[str],
+    deadline: float,
+    counters: Counters,
+    mode: str,
+    tx_bytes: int,
+    per_worker_rate: float,
+    fee: int,
+    signed: bool,
+) -> None:
+    key = Ed25519PrivKey.from_secret(b"loadgen-%d" % wid)
+    method = f"broadcast_tx_{mode}"
+    seq = 0
+    next_send = time.monotonic()
+    while time.monotonic() < deadline:
+        if per_worker_rate > 0:
+            now = time.monotonic()
+            if now < next_send:
+                await asyncio.sleep(next_send - now)
+            next_send += 1.0 / per_worker_rate
+        tx = make_tx(key, wid, seq, tx_bytes, fee=fee, signed=signed)
+        seq += 1
+        url = targets[seq % len(targets)]
+        req = {
+            "jsonrpc": "2.0", "id": seq, "method": method,
+            "params": {"tx": {"@b": base64.b64encode(tx).decode()}},
+        }
+        counters.offered += 1
+        try:
+            async with session.post(url, json=req) as resp:
+                d = await resp.json(content_type=None)
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            counters.transport += 1
+            continue
+        err = d.get("error")
+        if err:
+            code = err.get("code")
+            if code == SERVER_OVERLOADED:
+                counters.throttled += 1
+                hint = err.get("data")
+                if isinstance(hint, dict) and "retry_after" in hint:
+                    counters.retry_after_seen += 1
+            else:
+                counters.rejected += 1
+                counters.codes[str(code)] = counters.codes.get(str(code), 0) + 1
+        else:
+            res = d.get("result") or {}
+            if res.get("code", 0) == 0:
+                counters.accepted += 1
+            else:
+                counters.rejected += 1
+                counters.codes[f"app:{res.get('code')}"] = (
+                    counters.codes.get(f"app:{res.get('code')}", 0) + 1
+                )
+
+
+async def _commit_monitor(
+    session: aiohttp.ClientSession, url: str, deadline: float, out: dict
+) -> None:
+    """Poll one node's flight recorder for `step` events and keep the
+    first Commit-step timestamp per height; consecutive-height deltas are
+    the commit-latency-under-load samples."""
+    since = 0
+    commit_ns: Dict[int, int] = {}
+    while True:
+        now = time.monotonic()
+        if now >= deadline:
+            break
+        try:
+            async with session.get(
+                f"{url}/dump_flight_recorder?since={since}&kinds=step"
+            ) as resp:
+                d = await resp.json(content_type=None)
+            snap = d.get("result") or {}
+            since = snap.get("next_seq", since)
+            for ev in snap.get("events", []):
+                if ev.get("kind") == "step" and ev.get("step") == "Commit":
+                    commit_ns.setdefault(ev["height"], ev["t_ns"])
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            pass
+        await asyncio.sleep(min(0.5, max(0.05, deadline - time.monotonic())))
+    heights = sorted(commit_ns)
+    out["heights"] = len(heights)
+    out["intervals_ms"] = [
+        (commit_ns[b] - commit_ns[a]) / 1e6
+        for a, b in zip(heights, heights[1:])
+        if b == a + 1
+    ]
+
+
+async def run_load(
+    targets: List[str],
+    duration: float = 10.0,
+    rate: float = 0.0,
+    connections: int = 8,
+    tx_bytes: int = 192,
+    mode: str = "sync",
+    fee: int = 0,
+    signed: bool = True,
+    monitor_target: Optional[str] = None,
+    request_timeout: float = 10.0,
+) -> dict:
+    """Fire the firehose; returns the acceptance split + latency report.
+    `rate` is the TOTAL offered tx/sec across all connections (0 = as
+    fast as the connections can go)."""
+    urls = [_base_url(t) for t in targets]
+    counters = Counters()
+    monitor: dict = {}
+    deadline = time.monotonic() + duration
+    timeout = aiohttp.ClientTimeout(total=request_timeout)
+    connector = aiohttp.TCPConnector(limit=0)
+    async with aiohttp.ClientSession(timeout=timeout, connector=connector) as session:
+        tasks = [
+            asyncio.create_task(
+                _worker(
+                    i, session, urls, deadline, counters, mode, tx_bytes,
+                    rate / connections if rate > 0 else 0.0, fee, signed,
+                )
+            )
+            for i in range(connections)
+        ]
+        tasks.append(
+            asyncio.create_task(
+                _commit_monitor(
+                    session, monitor_target and _base_url(monitor_target) or urls[0],
+                    deadline, monitor,
+                )
+            )
+        )
+        await asyncio.gather(*tasks)
+    intervals = monitor.get("intervals_ms", [])
+    return {
+        "duration_s": round(duration, 2),
+        "connections": connections,
+        "mode": mode,
+        "tx_bytes": tx_bytes,
+        "offered_tps": round(counters.offered / duration, 1),
+        "tx_ingress_sustained_tps": round(counters.accepted / duration, 1),
+        "commit_latency_under_load_ms": percentiles(intervals),
+        "commits_under_load": monitor.get("heights", 0),
+        **counters.as_dict(),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("targets", help="comma-separated RPC addresses (host:port,...)")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="total offered tx/sec (0 = as fast as possible)")
+    ap.add_argument("--connections", type=int, default=8)
+    ap.add_argument("--tx-bytes", type=int, default=192)
+    ap.add_argument("--mode", choices=["sync", "async"], default="sync")
+    ap.add_argument("--fee", type=int, default=0,
+                    help="fee:<n>: priority prefix on every payload")
+    ap.add_argument("--plain", action="store_true",
+                    help="send bare payloads instead of signed envelopes")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    result = asyncio.run(
+        run_load(
+            [t for t in args.targets.split(",") if t],
+            duration=args.duration,
+            rate=args.rate,
+            connections=args.connections,
+            tx_bytes=args.tx_bytes,
+            mode=args.mode,
+            fee=args.fee,
+            signed=not args.plain,
+        )
+    )
+    if args.json:
+        print(json.dumps(result))
+    else:
+        lat = result["commit_latency_under_load_ms"]
+        print(
+            f"offered {result['offered_tps']}/s  accepted "
+            f"{result['tx_ingress_sustained_tps']}/s  throttled "
+            f"{result['throttled']}  rejected {result['rejected']}  "
+            f"transport {result['transport_errors']}  commit-latency p50 "
+            f"{lat['p50']} ms / p90 {lat['p90']} ms over "
+            f"{result['commits_under_load']} commits"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
