@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench bench-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke scale-smoke bls-smoke load-smoke localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke scale-smoke bls-smoke load-smoke forensics-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -46,6 +46,10 @@ bls-smoke:       ## BLS12-381 localnet: every stored commit must be ONE aggregat
 load-smoke:      ## tx-ingress firehose vs a QoS-configured 4-val localnet: explicit overload errors, zero checker violations, commit rate recovers
 	$(PY) networks/local/load_smoke.py --json
 	rm -rf build-load
+
+forensics-smoke: ## watchdog detects an injected partition live; a SIGKILLed node's debug bundle reconstructs its pre-crash span chains from the spool, offline
+	$(PY) networks/local/forensics_smoke.py --json
+	rm -rf build-forensics
 
 localnet:        ## 4-validator net as OS processes (no docker)
 	$(PY) -m tendermint_tpu.cli testnet --validators 4 --output ./build

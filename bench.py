@@ -437,6 +437,32 @@ def bench_load():
         return json.loads(run.stdout.strip().splitlines()[-1])
 
 
+def bench_forensics():
+    """Crash forensics + self-diagnosis as numbers: run the forensics rig
+    (networks/local/forensics_smoke.py — flight spool + watchdog armed on
+    a 4-val chaos localnet) and report `crash_bundle_completeness` (share
+    of a SIGKILLed node's interior pre-crash heights whose full
+    propose→commit span chain reconstructs OFFLINE from its on-disk
+    spool via `debug dump`; must be 1.0) and `health_detect_latency_ms`
+    (wall ms from an injected partition to the node's own consensus_stall
+    alarm on /health).  Raises if the bundle was incomplete, the alarm
+    never fired/cleared, or any false alarm hit the quiet phase."""
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as tmp:
+        run = subprocess.run(
+            [sys.executable, os.path.join(repo, "networks", "local", "forensics_smoke.py"),
+             "--build-dir", os.path.join(tmp, "build"), "--base-port", "32856", "--json"],
+            capture_output=True, text=True, timeout=420, cwd=repo,
+        )
+        if run.returncode != 0:
+            raise RuntimeError(f"forensics smoke failed:\n{run.stdout}\n{run.stderr}")
+        return json.loads(run.stdout.strip().splitlines()[-1])
+
+
 def bench_statesync_bootstrap():
     """Statesync bootstrap time, measured from REAL recorder spans: an
     empty 4th node joins a live 3-validator localnet via snapshot restore
@@ -797,6 +823,10 @@ def main() -> None:
         load = bench_load()
     except Exception as e:
         load = {"tx_ingress_sustained_tps": -1.0, "error": str(e)[:300]}
+    try:
+        forensics = bench_forensics()
+    except Exception as e:
+        forensics = {"crash_bundle_completeness": -1.0, "error": str(e)[:300]}
     extras = {
         "commit_verify_100val_ms": bench_100val_commit(),
         "e2e_commits_per_sec_solo": asyncio.run(bench_e2e_commits()),
@@ -842,6 +872,10 @@ def main() -> None:
         "chaos_partition_recovery_ms": chaos.get("chaos_partition_recovery_ms", -1.0),
         "chaos_restart_recovery_ms": chaos.get("restart_recovery_ms"),
         "chaos_evidence_height": chaos.get("evidence_height"),
+        "crash_bundle_completeness": forensics.get("crash_bundle_completeness", -1.0),
+        "health_detect_latency_ms": forensics.get("health_detect_latency_ms", -1.0),
+        "health_clear_ms": forensics.get("health_clear_ms"),
+        "forensics_spool_events": forensics.get("spool_events"),
         "e2e_commits_per_sec_100val": scale.get("e2e_commits_per_sec_100val", -1.0),
         "scale_100val_block_ms": scale.get("block_ms"),
         "scale_100val_startup_s": scale.get("startup_s"),

@@ -25,6 +25,14 @@ from every node each poll and accumulates violations:
   - accountability: the twin's DuplicateVoteEvidence is committed into a
     block AND surfaces via BeginBlock byzantine_validators (the kvstore
     app records delivered addresses under the `__byzantine__` key)
+  - self-diagnosis (libs/watchdog.py): every non-twin node's /health must
+    be alarm-free through the pre-partition quiet phase (zero false
+    alarms), the consensus_stall alarm must FIRE on a non-twin node while
+    the partition holds (`health_detect_latency_ms`), and by the end of
+    the recovery budget every live non-twin node must have CLEARED it —
+    the node noticed the fault and noticed the recovery, by itself.
+    (The twin is exempt: it reference-correctly halts on its own
+    conflict, and its stall alarm firing is the watchdog being right.)
 
 With --json the last stdout line carries `chaos_partition_recovery_ms`
 (heal -> first new commit, wall ms) — the number bench.py reports.
@@ -155,6 +163,51 @@ def main() -> int:
 
         live = [True] * 4
 
+        # watchdog observation state: quiet -> partition -> post_heal
+        hstate = {
+            "phase": "quiet",
+            "t_partition": None,
+            "detect_t": None,
+            "quiet_alarms": set(),
+            "clear_t": None,
+        }
+
+        def health_of(port):
+            try:
+                return rpc(port, "health")["result"]
+            except Exception:
+                return None
+
+        def poll_health():
+            """Non-twin /health sampling: quiet-phase alarms are false
+            positives; the first consensus_stall during the partition is
+            the detection landmark; all-clear is tracked for the end."""
+            stall_free = True
+            for i, p in enumerate(ports):
+                if i == 0 or not live[i]:
+                    continue
+                h = health_of(p)
+                if h is None:
+                    stall_free = False  # unreachable ≠ clear
+                    continue
+                alarms = set(h.get("alarms", {}))
+                if hstate["phase"] == "quiet" and alarms:
+                    hstate["quiet_alarms"].update(f"node{i}:{a}" for a in alarms)
+                if (
+                    hstate["phase"] == "partition"
+                    and hstate["detect_t"] is None
+                    and "consensus_stall" in alarms
+                ):
+                    hstate["detect_t"] = time.time()
+                    print(
+                        f"  watchdog: node{i} raised consensus_stall "
+                        f"{hstate['detect_t'] - hstate['t_partition']:.1f}s "
+                        f"after the partition"
+                    )
+                if "consensus_stall" in alarms:
+                    stall_free = False
+            return stall_free
+
         def scrape():
             hs = []
             for i, p in enumerate(ports):
@@ -198,6 +251,7 @@ def main() -> int:
         for ev in timeline:
             while time.time() < t0 + ev.t:
                 scrape()
+                poll_health()
                 time.sleep(0.4)
             print(f"+{time.time() - t0:6.2f}s executing {ev.describe()}")
             if ev.action == "twin":
@@ -214,6 +268,8 @@ def main() -> int:
                                          peer_id=node_ids[a], drop=1.0)
                 time.sleep(1.0)  # drain in-flight gossip
                 stall_window = (time.time(), tip_of(range(4)))
+                hstate["phase"] = "partition"
+                hstate["t_partition"] = time.time()
             elif ev.action == "heal":
                 # the stall assertion: a 2|2 split has no +2/3 side, so at
                 # most one in-flight height may have landed since the cut
@@ -226,6 +282,10 @@ def main() -> int:
                         )
                     print(f"  partition stalled the net at ~{stall_window[1]} "
                           f"for {time.time() - stall_window[0]:.1f}s (tip {tip})")
+                # detection must have happened while the cut still held
+                if hstate["detect_t"] is None:
+                    poll_health()  # one last chance at the boundary
+                hstate["phase"] = "post_heal"
                 baseline = tip_of(range(4))
                 for i, p in enumerate(ports):
                     if live[i]:
@@ -249,6 +309,10 @@ def main() -> int:
         deadline = time.time() + args.budget
         while time.time() < deadline:
             scrape()
+            if poll_health() and hstate["clear_t"] is None:
+                hstate["clear_t"] = time.time()
+                print(f"  watchdog: consensus_stall clear on every live "
+                      f"non-twin node at +{time.time() - t0:.1f}s")
             if evidence_height is None:
                 tip = height_of(ports[1]) or 0
                 for h in range(1, tip + 1):
@@ -271,16 +335,25 @@ def main() -> int:
                 except Exception:
                     pass
             if (not heal_timer.unrecovered() and not restart_timer.unrecovered()
-                    and evidence_height is not None and byz_delivered):
+                    and evidence_height is not None and byz_delivered
+                    and hstate["clear_t"] is not None):
                 break
             time.sleep(0.4)
 
+        detect_ms = (
+            round((hstate["detect_t"] - hstate["t_partition"]) * 1000, 1)
+            if hstate["detect_t"] is not None and hstate["t_partition"] is not None
+            else -1.0
+        )
         result = {
             "metric": "chaos_smoke",
             "fingerprint": scenario.fingerprint(),
             "seed": args.seed,
             "chaos_partition_recovery_ms": round(heal_timer.recovery_ms.get("heal", -1.0), 1),
             "restart_recovery_ms": round(restart_timer.recovery_ms.get("restart", -1.0), 1),
+            "health_detect_latency_ms": detect_ms,
+            "health_quiet_alarms": sorted(hstate["quiet_alarms"]),
+            "health_stall_cleared": hstate["clear_t"] is not None,
             "evidence_height": evidence_height,
             "byzantine_validators_delivered": byz_delivered,
             "heights": [height_of(p) for p in ports],
@@ -303,6 +376,20 @@ def main() -> int:
             failures.append("byzantine_validators never delivered via BeginBlock")
         if len(checker.agreed_heights()) < 3:
             failures.append("too few heights cross-checked for agreement")
+        if hstate["detect_t"] is None:
+            failures.append(
+                "watchdog never raised consensus_stall during the partition"
+            )
+        if hstate["quiet_alarms"]:
+            failures.append(
+                f"watchdog false alarms during the quiet phase: "
+                f"{sorted(hstate['quiet_alarms'])}"
+            )
+        if hstate["clear_t"] is None:
+            failures.append(
+                "watchdog consensus_stall never cleared on every live "
+                "non-twin node after recovery"
+            )
         if failures:
             print("CHAOS SMOKE FAILED:", file=sys.stderr)
             for f in failures:
@@ -312,9 +399,11 @@ def main() -> int:
                 f"chaos smoke ok: agreement over "
                 f"{len(checker.agreed_heights())} heights, heal recovery "
                 f"{result['chaos_partition_recovery_ms']:.0f} ms, restart "
-                f"recovery {result['restart_recovery_ms']:.0f} ms, twin "
-                f"evidence committed at height {evidence_height} and "
-                f"delivered via BeginBlock"
+                f"recovery {result['restart_recovery_ms']:.0f} ms, stall "
+                f"alarm in {result['health_detect_latency_ms']:.0f} ms "
+                f"(0 false alarms, cleared after heal), twin evidence "
+                f"committed at height {evidence_height} and delivered "
+                f"via BeginBlock"
             )
             ok = True
     finally:

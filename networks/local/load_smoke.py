@@ -102,16 +102,39 @@ def spawn(home: str, env) -> subprocess.Popen:
     )
 
 
-async def _load_phase(ports, checker, args):
+def poll_status_health(ports, health_seen) -> None:
+    """Sample every node's /status `health` block (the watchdog verdict):
+    the rig asserts the node SELF-reports degradation under the firehose
+    — shedding alone could be a node lying to itself about being fine."""
+    for i, p in enumerate(ports):
+        try:
+            st = rpc(p, "status")["result"]
+        except Exception:
+            continue
+        h = st.get("health")
+        if h is None:
+            continue
+        health_seen["block_present"] = True
+        if h.get("verdict") != "ok":
+            health_seen["degraded"].update(
+                f"node{i}:{a}" for a in h.get("alarms", ["<no-alarm-name>"])
+            )
+
+
+async def _load_phase(ports, checker, args, health_seen):
     """Run the firehose and the checker scraper concurrently on one loop
     (the scraper hops to a thread per poll so the loadgen workers keep
     the loop)."""
     targets = [f"127.0.0.1:{p}" for p in ports]
     stop = asyncio.Event()
 
+    def _scrape_once():
+        scrape(checker, ports)
+        poll_status_health(ports, health_seen)
+
     async def scraper():
         while not stop.is_set():
-            await asyncio.get_event_loop().run_in_executor(None, scrape, checker, ports)
+            await asyncio.get_event_loop().run_in_executor(None, _scrape_once)
             try:
                 await asyncio.wait_for(stop.wait(), 0.5)
             except asyncio.TimeoutError:
@@ -206,8 +229,9 @@ def main() -> int:
         idle_cps = commit_rate(ports, args.idle, checker)
         print(f"idle commit rate: {idle_cps:.2f} blocks/sec")
 
+        health_seen = {"block_present": False, "degraded": set()}
         t0 = time.time()
-        load = asyncio.run(_load_phase(ports, checker, args))
+        load = asyncio.run(_load_phase(ports, checker, args, health_seen))
         load_wall = time.time() - t0
         tip_after_load = max(
             (h for h in (height_of(p) for p in ports) if h is not None), default=0
@@ -223,6 +247,27 @@ def main() -> int:
         recover_cps = commit_rate(ports, args.recover, checker)
         print(f"recovery commit rate: {recover_cps:.2f} blocks/sec "
               f"(idle was {idle_cps:.2f})")
+        if health_seen["degraded"]:
+            print(f"self-reported degradation under load: "
+                  f"{sorted(health_seen['degraded'])}")
+        # the degradation must CLEAR once the firehose is off — poll past
+        # the recovery window for every node to report ok again (mempool
+        # drains as blocks commit; lag subsides)
+        health_recovered = False
+        clear_deadline = time.time() + 20.0
+        while time.time() < clear_deadline:
+            verdicts = []
+            for p in ports:
+                try:
+                    verdicts.append(
+                        rpc(p, "status")["result"].get("health", {}).get("verdict")
+                    )
+                except Exception:
+                    verdicts.append(None)
+            if all(v == "ok" for v in verdicts):
+                health_recovered = True
+                break
+            time.sleep(0.5)
 
         lat = load["commit_latency_under_load_ms"]
         result = {
@@ -238,6 +283,8 @@ def main() -> int:
             "commits_under_load": load["commits_under_load"],
             "idle_commits_per_sec": round(idle_cps, 2),
             "recovery_commits_per_sec": round(recover_cps, 2),
+            "health_degraded_under_load": sorted(health_seen["degraded"]),
+            "health_recovered": health_recovered,
             "heights": [height_of(p) for p in ports],
             **checker.summary(),
         }
@@ -277,6 +324,17 @@ def main() -> int:
             )
         if len(checker.agreed_heights()) < 3:
             failures.append("too few heights cross-checked for agreement")
+        if not health_seen["block_present"]:
+            failures.append("/status never carried a health block (watchdog off?)")
+        if not health_seen["degraded"]:
+            failures.append(
+                "no node self-reported degradation during the firehose "
+                "(the watchdog missed sustained saturation)"
+            )
+        if not health_recovered:
+            failures.append(
+                "health verdict did not return to ok after the firehose"
+            )
         if failures:
             print("LOAD SMOKE FAILED:", file=sys.stderr)
             for f in failures:

@@ -147,6 +147,10 @@ async def build_net(tmp: str, args, cpu_only: bool):
         # full multi-minute block interval instead of evicting it.
         cfg.instrumentation.loop_probe_interval = args.probe_interval
         cfg.instrumentation.trace_sample_high_rate = args.trace_sample
+        # 100 per-node watchdog tickers would add 50+ wakeups/sec to an
+        # already loop-bound rig (the exact class PR 6 trimmed); the
+        # checker judges this net from outside
+        cfg.instrumentation.watchdog = False
         cfg.chaos.enabled = True
         cfg.chaos.seed = args.seed
         nodes.append(Node(cfg, gen, priv_validator=pv, db_backend="memdb"))

@@ -249,6 +249,11 @@ def cmd_testnet(args) -> int:
             # must tick INSIDE each block interval or per-block loop
             # attribution (the trace-net-smoke gate) has nothing to read
             cfg.instrumentation.loop_probe_interval = 0.02
+            # watchdog at rig scale: a --fast net commits ~10 blocks/sec,
+            # so seconds of silence IS a stall — the chaos/forensics rigs
+            # assert detection latency against these bounds
+            cfg.instrumentation.watchdog_interval = 0.25
+            cfg.instrumentation.watchdog_stall_seconds = 3.0
         elif args.db_backend:
             cfg.base.db_backend = args.db_backend
         if chaos:
@@ -413,6 +418,16 @@ def cmd_trace(args) -> int:
         if rep["truncated"]:
             msg += f" ({len(rep['truncated'])} truncated by ring wrap)"
         print(msg)
+        dropped = snap.get("dropped", 0)
+        if dropped:
+            # silent span loss is exactly what the forensics layer exists
+            # to prevent — surface it here AND as the
+            # tendermint_recorder_dropped_total gauge
+            print(
+                f"warning: {dropped} events already evicted from the ring "
+                "(raise [instrumentation] flight_recorder_size, sample "
+                "high-rate kinds, or enable flight_spool to persist them)"
+            )
     return 0
 
 
@@ -484,49 +499,234 @@ def cmd_version(args) -> int:
     return 0
 
 
-def cmd_debug_dump(args) -> int:
-    """commands/debug/dump.go — bundle status + net_info +
-    dump_consensus_state + task dump from a running node's RPC into a
-    timestamped directory (one per --interval tick)."""
+async def _debug_rpc_sections(rpc_laddr: str) -> dict:
+    """The live half of a debug bundle: every introspection route a
+    running node serves, each independently fallible (an unsafe route
+    gated off — or a node wedged enough that one handler hangs — must not
+    sink the rest of the bundle)."""
     from .rpc.client import HTTPClient
 
-    async def one_dump(idx: int) -> None:
-        out_dir = os.path.join(args.output, f"dump_{idx}_{int(time.time())}")
-        os.makedirs(out_dir, exist_ok=True)
-        async with HTTPClient(args.rpc_laddr) as c:
-            for name, method, params in (
-                ("status", "status", {}),
-                ("net_info", "net_info", {}),
-                ("consensus_state", "dump_consensus_state", {}),
-                ("tasks", "unsafe_dump_tasks", {}),
-            ):
-                try:
-                    res = await c._call(method, params)
-                except Exception as e:  # unsafe routes may be gated off
-                    res = {"error": str(e)}
-                with open(os.path.join(out_dir, f"{name}.json"), "w") as f:
-                    json.dump(res, f, indent=1, default=repr)
-        print(f"wrote {out_dir}")
+    sections = {}
+    async with HTTPClient(rpc_laddr) as c:
+        for name, method, params in (
+            ("status", "status", {}),
+            ("net_info", "net_info", {}),
+            ("consensus_state", "dump_consensus_state", {}),
+            ("recorder", "dump_flight_recorder", {}),
+            ("health", "health", {}),
+            ("tasks", "unsafe_dump_tasks", {}),
+        ):
+            try:
+                sections[name] = await asyncio.wait_for(c._call(method, params), 10.0)
+            except Exception as e:  # noqa: BLE001 — per-section degradation
+                sections[name] = {"error": repr(e)}
+    return sections
 
-    async def main():
-        # interval > 0 with no explicit --count loops until interrupted
-        # (the reference `debug dump` behaves the same); otherwise one
-        # dump per count.
-        forever = args.interval > 0 and args.count <= 0
-        i = 0
+
+def _scrape_metrics(listen_addr: str) -> "bytes | None":
+    """One prometheus exposition scrape for the bundle (best effort)."""
+    import urllib.request
+
+    host, _, port = listen_addr.split("://")[-1].rpartition(":")
+    url = f"http://{host or '127.0.0.1'}:{port}/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=3) as r:
+            return r.read()
+    except Exception:
+        return None
+
+
+def _tail_file(path: str, n: int = 65536) -> "bytes | None":
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - n))
+            return f.read()
+    except OSError:
+        return None
+
+
+def _sanitized_config_text(path: str) -> "str | None":
+    """config.toml for the bundle with secret-shaped values redacted.
+    The config holds no key material today (keys live in their own
+    files, which a bundle NEVER touches) — the redaction is the
+    guarantee that stays true if a token-bearing knob ever lands."""
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return None
+    out = []
+    for line in lines:
+        key = line.split("=", 1)[0].strip().lower()
+        if "=" in line and any(s in key for s in ("secret", "password", "token")):
+            out.append(f"{line.split('=', 1)[0]}= \"<redacted>\"\n")
+        else:
+            out.append(line)
+    return "".join(out)
+
+
+def _build_debug_bundle(home: str, rpc_laddr: str, offline: bool) -> dict:
+    """Assemble every section of a forensics bundle as {filename: bytes}.
+
+    Live sections come from the node's RPC; home-dir sections (sanitized
+    config, consensus/mempool WAL tails, the crash spool replay) need
+    only the disk — so the SAME command produces a useful bundle from a
+    node that is already dead (`--offline`, or RPC simply unreachable).
+    The span/loop reports are derived from the best available event
+    stream: the live recorder when reachable, else the on-disk spool —
+    a SIGKILLed node's pre-crash step chains reconstruct from the spool
+    alone."""
+    from .libs import tracemerge, tracing
+
+    home = os.path.expanduser(home)
+    cfg = _load_cfg(home)
+    files: dict = {}
+    manifest: dict = {
+        "created_unix": int(time.time()),
+        "home": home,
+        "mode": "offline" if offline else "live",
+        "sections": [],
+    }
+
+    rpc_sections: dict = {}
+    if not offline:
+        try:
+            rpc_sections = asyncio.run(_debug_rpc_sections(rpc_laddr))
+        except Exception as e:  # node down: degrade to the home dir
+            manifest["rpc_error"] = repr(e)
+            rpc_sections = {}
+        for name, obj in rpc_sections.items():
+            files[f"{name}.json"] = json.dumps(obj, indent=1, default=repr).encode()
+        if rpc_sections and cfg.instrumentation.prometheus:
+            prom = _scrape_metrics(cfg.instrumentation.prometheus_listen_addr)
+            if prom is not None:
+                files["metrics.prom"] = prom
+
+    cfg_text = _sanitized_config_text(
+        os.path.join(home, "config", "config.toml")
+    )
+    if cfg_text is not None:
+        files["config.toml"] = cfg_text.encode()
+    wal_tail = _tail_file(cfg.wal_file())
+    if wal_tail is not None:
+        files["cs_wal.tail"] = wal_tail
+    if cfg.mempool.wal_dir:
+        mwal = _tail_file(os.path.join(cfg.mempool_wal_dir(), "wal"))
+        if mwal is not None:
+            files["mempool_wal.tail"] = mwal
+
+    # the crash spool: raw tail for byte-level forensics plus the torn-
+    # tail-tolerant replay as a dump-shaped JSON trace-net can merge
+    spool_path = cfg.flight_spool_file()
+    spool_dump = None
+    if tracing.spool_paths(spool_path):
+        raw = _tail_file(spool_path, 1 << 20)
+        if raw is not None:
+            files["flight.spool.tail"] = raw
+        # the spool's own anchor records the writing node's name; the
+        # config moniker is only the fallback for a nameless spool
+        spool_dump = tracing.read_spool(spool_path)
+        if not spool_dump.get("node"):
+            spool_dump["node"] = cfg.base.moniker
+        files["spool.json"] = json.dumps(spool_dump, default=repr).encode()
+
+    # derived reports from the best event source available (the already-
+    # decoded RPC section — no reason to re-parse megabytes of events we
+    # just serialized)
+    src = None
+    rec = rpc_sections.get("recorder")
+    if isinstance(rec, dict) and rec.get("events"):
+        src = rec
+    if src is None and spool_dump is not None and spool_dump["events"]:
+        src = spool_dump
+    if src is not None:
+        events = src["events"]
+        files["span_report.json"] = json.dumps(
+            tracing.span_report(
+                events, dropped=src.get("dropped", 0), since=src.get("since", 0)
+            )
+        ).encode()
+        files["loop_report.json"] = json.dumps(
+            {
+                "block_breakdown": tracing.block_breakdown(events),
+                "attribution_by_height": tracemerge.attribution_by_height(dict(src)),
+            },
+            default=repr,
+        ).encode()
+        manifest["event_source"] = src.get("source", "recorder")
+        manifest["events"] = len(events)
+
+    manifest["sections"] = sorted(files)
+    files["manifest.json"] = json.dumps(manifest, indent=1).encode()
+    return files
+
+
+def _write_debug_bundle(files: dict, out_path: str) -> str:
+    import io
+    import tarfile
+
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    prefix = os.path.basename(out_path).split(".tar")[0]
+    with tarfile.open(out_path, "w:gz") as tar:
+        for name in sorted(files):
+            data = files[name]
+            info = tarfile.TarInfo(f"{prefix}/{name}")
+            info.size = len(data)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(data))
+    return out_path
+
+
+def cmd_debug_dump(args) -> int:
+    """commands/debug/dump.go — one timestamped forensics bundle
+    (status, net_info, consensus dump, flight-recorder snapshot, health,
+    task dump, metrics scrape, sanitized config, WAL tails, crash-spool
+    replay and derived span/loop reports) as a tar.gz; `--frequency N`
+    takes periodic bundles.  Works OFFLINE from a home directory when the
+    node is already dead — the spool replay stands in for the live
+    recorder."""
+    interval = args.frequency if args.frequency > 0 else args.interval
+    forever = interval > 0 and args.count <= 0
+    i = 0
+    try:
         while forever or i < max(args.count, 1):
-            await one_dump(i)
+            files = _build_debug_bundle(args.home, args.rpc_laddr, args.offline)
+            out = os.path.join(
+                os.path.abspath(args.output), f"bundle_{i}_{int(time.time())}.tar.gz"
+            )
+            _write_debug_bundle(files, out)
+            print(f"wrote {out} ({len(files)} sections)")
             i += 1
             more = forever or i < args.count
-            if args.interval > 0 and more:
-                await asyncio.sleep(args.interval)
+            if interval > 0 and more:
+                time.sleep(interval)
             elif not more:
                 break
-
-    try:
-        asyncio.run(main())
     except KeyboardInterrupt:
+        # Ctrl-C is the documented exit for --frequency with no --count —
+        # and building a bundle against a WEDGED node can block for up to
+        # a minute of per-section timeouts, which is exactly when an
+        # operator interrupts; exit cleanly with whatever is on disk
         pass
+    return 0
+
+
+def cmd_debug_kill(args) -> int:
+    """commands/debug/kill.go — capture a bundle from the running node,
+    then SIGKILL its pid: the evidence is on disk BEFORE the process
+    dies, and the spool/WAL tails show its final moments."""
+    files = _build_debug_bundle(args.home, args.rpc_laddr, offline=False)
+    out = args.output or f"debug_kill_{args.pid}_{int(time.time())}.tar.gz"
+    _write_debug_bundle(files, os.path.abspath(out))
+    print(f"wrote {os.path.abspath(out)} ({len(files)} sections)")
+    try:
+        os.kill(args.pid, signal.SIGKILL)
+        print(f"killed pid {args.pid}")
+    except OSError as e:
+        print(f"kill {args.pid} failed: {e}", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -621,21 +821,47 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--trusting-period", type=float, default=168 * 3600)
     sp.set_defaults(fn=cmd_light)
 
-    sp = sub.add_parser("debug", help="capture a debug bundle from a running node")
+    sp = sub.add_parser(
+        "debug", help="capture forensics bundles from a running (or dead) node"
+    )
     dsub = sp.add_subparsers(dest="debug_cmd", required=True)
-    dp = dsub.add_parser("dump", help="write status/net_info/consensus-state/task bundle")
+    dp = dsub.add_parser(
+        "dump",
+        help="write a tar.gz forensics bundle (status/consensus/recorder/"
+        "health/metrics/config/WAL+spool tails); works offline from --home "
+        "when the node is dead",
+    )
     dp.add_argument("--rpc-laddr", default="127.0.0.1:26657")
     dp.add_argument("--output", default="debug_dump")
     dp.add_argument(
         "--interval", type=float, default=0.0, help="seconds between dumps (0 = one dump)"
     )
     dp.add_argument(
+        "--frequency", type=float, default=0.0,
+        help="reference-parity alias for --interval (takes precedence when set)",
+    )
+    dp.add_argument(
         "--count",
         type=int,
         default=0,
-        help="number of dumps; 0 with --interval > 0 = until interrupted",
+        help="number of dumps; 0 with an interval > 0 = until interrupted",
+    )
+    dp.add_argument(
+        "--offline", action="store_true",
+        help="skip the RPC entirely: build the bundle from the home dir "
+        "(sanitized config, WAL tails, crash-spool replay) — the dead-node path",
     )
     dp.set_defaults(fn=cmd_debug_dump)
+    dp = dsub.add_parser(
+        "kill", help="capture a bundle from the node, then SIGKILL its pid"
+    )
+    dp.add_argument("pid", type=int, help="pid of the tendermint_tpu node process")
+    dp.add_argument("--rpc-laddr", default="127.0.0.1:26657")
+    dp.add_argument(
+        "--output", default="",
+        help="bundle path (default debug_kill_<pid>_<ts>.tar.gz)",
+    )
+    dp.set_defaults(fn=cmd_debug_kill)
 
     sp = sub.add_parser("trace", help="dump a running node's flight recorder")
     sp.add_argument("--rpc-laddr", default="127.0.0.1:26657")

@@ -331,6 +331,44 @@ class InstrumentationConfig:
     # it defaults on.  `false` is a true no-op (spawn pays one None check).
     loop_profiler: bool = True
     loop_probe_interval: float = 0.25
+    # Crash-persistent flight spool (libs/tracing.FlightSpool): a size-
+    # capped rotating on-disk journal of recorder events, flushed on a
+    # cadence OFF the recording hot path (plus on excepthook/atexit/node
+    # stop), so a SIGKILLed or OOMed node leaves its last seconds of span
+    # events on disk for `debug dump` / trace-net to replay offline.
+    # Opt-in: it costs ~one small buffered write per flush interval.
+    flight_spool: bool = False
+    flight_spool_path: str = "data/flight.spool"
+    flight_spool_flush_interval: float = 0.25
+    flight_spool_size_limit: int = 4 * 1024 * 1024
+    # Health watchdog (libs/watchdog.py): periodic self-diagnosis —
+    # consensus stall, round churn, peer collapse, verify-queue stall,
+    # event-loop lag, mempool saturation, wall-vs-monotonic clock drift —
+    # exported as tendermint_health_* gauges, an ok/degraded/critical
+    # verdict on the /health RPC route and a `health` block in /status,
+    # with health.alarm/health.clear recorder events on transitions and a
+    # rate-bounded forensics auto-bundle on the critical transition.
+    watchdog: bool = True
+    watchdog_interval: float = 2.0
+    # stall: tip not advancing for this long while caught_up (monotonic
+    # clock — injected wall skew must not fake or mask a stall)
+    watchdog_stall_seconds: float = 30.0
+    watchdog_round_churn: int = 4
+    watchdog_verify_stall_seconds: float = 5.0
+    watchdog_lag_ms: float = 1000.0
+    watchdog_mempool_ratio: float = 0.9
+    # sustained explicit overload rejections per second (two consecutive
+    # ticks over the bound): the QoS layer shedding correctly is still a
+    # node that cannot serve its offered load.  0 disables.
+    watchdog_shed_rate: float = 5.0
+    # wall-vs-monotonic divergence since watchdog start; a CONSTANT offset
+    # (NTP being early/late, [chaos] clock_skew from boot) is not drift
+    watchdog_clock_drift_seconds: float = 2.0
+    # peer collapse: alarm when the live peer count falls below half of
+    # the peak this node has seen (and the peak was at least min_peers)
+    watchdog_min_peers: int = 2
+    watchdog_autodump: bool = True
+    watchdog_autodump_min_interval: float = 60.0
 
 
 @dataclass
@@ -372,6 +410,9 @@ class Config:
 
     def mempool_wal_dir(self) -> str:
         return self._join(self.mempool.wal_dir)
+
+    def flight_spool_file(self) -> str:
+        return self._join(self.instrumentation.flight_spool_path)
 
     def db_dir(self) -> str:
         return self._join("data")
@@ -426,6 +467,27 @@ class Config:
             raise ValueError("instrumentation.trace_sample_high_rate must be >= 1")
         if self.instrumentation.loop_probe_interval <= 0:
             raise ValueError("instrumentation.loop_probe_interval must be > 0")
+        inst = self.instrumentation
+        if inst.flight_spool_flush_interval <= 0:
+            raise ValueError("instrumentation.flight_spool_flush_interval must be > 0")
+        if inst.flight_spool_size_limit < 4096:
+            raise ValueError("instrumentation.flight_spool_size_limit must be >= 4096")
+        if inst.watchdog_interval <= 0:
+            raise ValueError("instrumentation.watchdog_interval must be > 0")
+        if inst.watchdog_stall_seconds <= 0:
+            raise ValueError("instrumentation.watchdog_stall_seconds must be > 0")
+        if inst.watchdog_round_churn < 1:
+            raise ValueError("instrumentation.watchdog_round_churn must be >= 1")
+        if not 0 < inst.watchdog_mempool_ratio <= 1.0:
+            raise ValueError("instrumentation.watchdog_mempool_ratio must be in (0, 1]")
+        if inst.watchdog_shed_rate < 0:
+            raise ValueError("instrumentation.watchdog_shed_rate can't be negative")
+        if inst.watchdog_clock_drift_seconds <= 0:
+            raise ValueError("instrumentation.watchdog_clock_drift_seconds must be > 0")
+        if inst.watchdog_autodump_min_interval < 0:
+            raise ValueError(
+                "instrumentation.watchdog_autodump_min_interval can't be negative"
+            )
         if self.consensus.gossip_part_burst < 1:
             raise ValueError("consensus.gossip_part_burst must be >= 1")
         if self.consensus.gossip_vote_batch_bytes < 1024:

@@ -138,6 +138,11 @@ class PeerRoundState:
         # gossip, so catchup ships the stored AggregateCommit once per
         # stuck height, re-sent on a coarse timer (lost-frame repair).
         self.agg_commit_sent: Tuple[int, float] = (0, 0.0)
+        # round-state re-announce dedupe: ((height, round, step) last
+        # announced to THIS peer, monotonic send time) — the maj23 tick's
+        # liveness repair for beliefs gone stale across a message-level
+        # partition (see _query_maj23_routine).
+        self.nrs_sent: Tuple[Optional[tuple], float] = (None, 0.0)
 
     # -- updates from peer messages ---------------------------------------
     def apply_new_round_step(self, msg: dict) -> None:
@@ -1361,9 +1366,31 @@ class ConsensusReactor(Reactor):
         interval) so the VoteSetBits repair exchange can still re-fire
         for a peer that stays stuck."""
         sleep = self.cs.config.peer_query_maj23_sleep_duration
+        resend_after = 10 * sleep
         while True:
             await asyncio.sleep(sleep)
             rs = self.cs.rs
+            # Round-state re-announce (liveness repair).  NewRoundStep is
+            # normally sent only on step transitions and on add_peer — a
+            # REAL partition breaks TCP, so reconnect re-announces via
+            # add_peer.  But a message-level fault (chaos drop policy, a
+            # middlebox eating frames on a live connection) drops the
+            # transition broadcasts while connections stay up: if the cut
+            # straddles a height transition, both sides' PeerRoundState
+            # beliefs go permanently stale and every post-heal vote push
+            # targets the WRONG height (measured: a healed 4-val net
+            # wedged at Precommit with 2/4 precommits for 70+ s — the
+            # watchdog's stall alarm is what surfaced it).  Re-announce
+            # when our state changed since the last announce this peer
+            # acked, and keep re-announcing at a slow repair cadence
+            # while the peer still looks desynced.
+            now = time.monotonic()
+            state = (rs.height, rs.round, rs.step)
+            sent_state, sent_t = ps.nrs_sent
+            desynced = (ps.height, ps.round) != (rs.height, rs.round)
+            if state != sent_state or (desynced and now - sent_t >= resend_after):
+                if await peer.send(STATE_CHANNEL, self._new_round_step_msg()):
+                    ps.nrs_sent = (state, now)
             if rs.votes is not None and rs.height == ps.height:
                 for vote_type, getter in (
                     (PREVOTE_TYPE, rs.votes.prevotes),

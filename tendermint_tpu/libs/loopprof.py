@@ -162,6 +162,9 @@ class LoopProfiler:
         self._lag_counts = [0] * len(self.LAG_BUCKETS_MS)
         self.lag_samples = 0
         self.lag_max_ms = 0.0
+        # most recent probe's lag — the health watchdog's "is the loop
+        # wedged RIGHT NOW" feed (max/p90 are cumulative, not current)
+        self.last_lag_ms = 0.0
         # gc accounting (ints only — the callback runs inside collections)
         self._gc_t0 = 0
         self._gc_pause_ns = 0
@@ -258,6 +261,7 @@ class LoopProfiler:
                 self._lag_counts[i] += 1
                 break
         self.lag_samples += 1
+        self.last_lag_ms = ms
         if ms > self.lag_max_ms:
             self.lag_max_ms = ms
         if self.metrics is not None:

@@ -533,6 +533,61 @@ class ChaosMetrics:
         )
 
 
+class HealthMetrics:
+    """Node self-diagnosis (subsystem `health`; libs/watchdog.py — no
+    reference counterpart: the reference node cannot notice its own
+    degradation).  `verdict` is the aggregate 0=ok / 1=degraded /
+    2=critical the /health RPC route serves to load balancers; `alarm`
+    is a 0/1 gauge per detector (consensus_stall, round_churn,
+    peer_collapse, verify_stall, loop_lag, mempool_saturation,
+    clock_drift); `alarms` counts raise transitions per detector
+    (`tendermint_health_alarms_total`).  `recorder_dropped` exposes the
+    flight recorder's ring-eviction count
+    (`tendermint_recorder_dropped_total`) — silent span loss was only
+    visible inside dump snapshots before."""
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        if registry is None:
+            self.verdict = _NOP
+            self.alarm = _NOP
+            self.alarms = _NOP
+            self.recorder_dropped = _NOP
+            return
+        from prometheus_client import Counter, Gauge
+
+        sub = "health"
+        self.verdict = Gauge(
+            "verdict", "Aggregate health verdict: 0=ok, 1=degraded, 2=critical.",
+            namespace=NAMESPACE, subsystem=sub, registry=registry,
+            labelnames=("chain_id",),
+        ).labels(chain_id=chain_id)
+        self.alarm = _BoundLabels(
+            Gauge(
+                "alarm", "Whether a watchdog detector is currently alarming (0/1).",
+                namespace=NAMESPACE, subsystem=sub, registry=registry,
+                labelnames=("chain_id", "alarm"),
+            ),
+            chain_id=chain_id,
+        )
+        self.alarms = _BoundLabels(
+            Counter(
+                "alarms", "Watchdog alarm raise transitions.",
+                namespace=NAMESPACE, subsystem=sub, registry=registry,
+                labelnames=("chain_id", "alarm"),
+            ),
+            chain_id=chain_id,
+        )
+        # different subsystem on purpose: the series belongs to the
+        # recorder, the watchdog tick merely publishes it
+        self.recorder_dropped = Gauge(
+            "dropped_total",
+            "Flight-recorder events evicted from the ring before any dump "
+            "or spool flush read them.",
+            namespace=NAMESPACE, subsystem="recorder", registry=registry,
+            labelnames=("chain_id",),
+        ).labels(chain_id=chain_id)
+
+
 class MetricsProvider:
     """node/node.go:128 DefaultMetricsProvider — one registry per node."""
 
@@ -554,6 +609,7 @@ class MetricsProvider:
         self.statesync = StateSyncMetrics(self.registry, chain_id)
         self.evidence = EvidenceMetrics(self.registry, chain_id)
         self.chaos = ChaosMetrics(self.registry, chain_id)
+        self.health = HealthMetrics(self.registry, chain_id)
 
     def exposition(self) -> bytes:
         if self.registry is None:

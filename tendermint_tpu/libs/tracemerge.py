@@ -48,12 +48,26 @@ from . import loopprof, tracing
 
 
 def load_dump(path: str, name: str = "") -> dict:
-    """Read one recorder dump from disk: either a raw snapshot (what
-    FlightRecorder.snapshot / `trace --json` emit) or a JSON-RPC response
-    wrapping one under "result".  `name` overrides the node label
-    (default: the dump's own `node` field, else the file stem)."""
-    with open(path) as fh:
-        d = json.load(fh)
+    """Read one recorder dump from disk: a raw snapshot (what
+    FlightRecorder.snapshot / `trace --json` emit), a JSON-RPC response
+    wrapping one under "result", or a crash spool (the JSON-lines journal
+    `[instrumentation] flight_spool` writes) — so a DEAD node's on-disk
+    spool merges into the network timeline exactly like a live node's RPC
+    dump.  `name` overrides the node label (default: the dump's own
+    `node` field, else the file stem)."""
+    try:
+        with open(path) as fh:
+            d = json.load(fh)
+    except ValueError:
+        # not one JSON document — a JSON-lines spool (torn-tail tolerant)
+        d = tracing.read_spool(path, name=name)
+        if not d["events"]:
+            raise ValueError(f"{path}: neither a flight-recorder dump nor a spool")
+    else:
+        if isinstance(d, dict) and d.get("type") == "anchor":
+            # a one-line spool (anchor written, no events yet) parses as
+            # plain JSON — still a spool
+            d = tracing.read_spool(path, name=name)
     if "result" in d and isinstance(d["result"], dict) and "events" in d["result"]:
         d = d["result"]
     if "events" not in d:

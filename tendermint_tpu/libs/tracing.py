@@ -99,6 +99,9 @@ consumers rely on.
 
 from __future__ import annotations
 
+import json
+import os
+import re
 import threading
 import time
 from typing import Callable, List, Optional, Sequence
@@ -231,6 +234,13 @@ class FlightRecorder:
             "events": events,
         }
 
+    @property
+    def dropped(self) -> int:
+        """Events that have aged out of the ring since start — the silent-
+        span-loss number `tendermint_recorder_dropped_total` exports and
+        `trace --check` warns about."""
+        return max(0, self._seq - self.size)
+
 
 def step_chains(events: List[dict]) -> dict:
     """Group `step` events into per-height chains: {height: {step_name:
@@ -356,3 +366,285 @@ def statesync_bootstrap_ms(events: List[dict]) -> Optional[float]:
     if not (o <= c <= r <= h):
         return None
     return (h - o) / 1e6
+
+
+# -- crash-persistent flight spool ------------------------------------------
+
+
+class FlightSpool:
+    """Crash-persistent sink for a FlightRecorder: an append-only rotating
+    on-disk journal ([instrumentation] flight_spool) so a SIGKILLed, OOMed
+    or wedged node leaves its last seconds of span events on disk.
+
+    Discipline mirrors the mempool tx WAL (libs/autofile.Group): a head
+    file plus rotated chunks, total size bounded by `size_limit` (oldest
+    chunks deleted first — eviction is oldest-first, exactly like the
+    in-memory ring, so `span_report`'s prefix-truncation tolerance applies
+    to spool replays too).  Records are JSON lines:
+
+        {"type": "anchor", "mono_ns", "wall_ns", "node", "lost"}   per flush
+        {"seq", "t_ns", "kind", ...fields}                         per event
+
+    The anchor line is re-sampled every flush so an offline replay carries
+    a fresh monotonic→wall mapping for tracemerge alignment; `lost` counts
+    events that aged out of the RING between flushes (the spool's own
+    watermark fell behind) — honest about what the disk copy is missing.
+
+    Crucially NOTHING here runs on the recording hot path: `record()` is
+    untouched, the spool reads the ring from a flush cadence (the node's
+    spool task), from the excepthook/atexit crash hooks, and from close().
+    A SIGKILL cannot be caught — for it, the periodic cadence is the
+    guarantee: everything up to the last flush (≤ flush_interval old)
+    survives.  Flush is threadsafe (task + atexit may race)."""
+
+    def __init__(
+        self,
+        path: str,
+        recorder: FlightRecorder,
+        size_limit: int = 4 * 1024 * 1024,
+        node: str = "",
+    ):
+        from .autofile import Group
+
+        self.recorder = recorder
+        self.node = node
+        self._group = Group(
+            path,
+            head_size_limit=max(4096, size_limit // 4),
+            group_size_limit=size_limit,
+        )
+        self._watermark = 0  # next recorder seq to spool
+        self._lock = threading.Lock()
+        self._closed = False
+        self._hooks_installed = False
+        self._prev_excepthook = None
+        self._hook_fn = None
+        # run id: the spool file survives restarts (append-mode head) but
+        # recorder seqs restart at 0 per process — without a per-run tag
+        # the replay's seq-dedup would keep the OLD run's events and
+        # present the previous run as the pre-crash evidence
+        self.run_id = os.urandom(4).hex()
+        self.flushes = 0
+        self.spooled = 0
+        self.lost = 0  # ring-wrap losses between flushes, cumulative
+
+    def flush(self, sync: bool = False) -> int:
+        """Append every ring event past the watermark; returns the number
+        written.  `sync=True` adds an fsync (crash hooks / close)."""
+        with self._lock:
+            if self._closed:
+                return 0
+            events = self.recorder.events(since=self._watermark)
+            lost = 0
+            if events and events[0]["seq"] > self._watermark and self._watermark > 0:
+                lost = events[0]["seq"] - self._watermark
+            elif not events:
+                # ring may have wrapped past the watermark with everything
+                # already evicted (huge burst between flushes)
+                lost = max(0, self.recorder._seq - self.recorder.size - self._watermark)
+                if lost == 0 and self.recorder._seq == self._watermark:
+                    return 0  # nothing new; skip the anchor line too
+            self.lost += lost
+            lines = [
+                json.dumps(
+                    {
+                        "type": "anchor",
+                        "run": self.run_id,
+                        "mono_ns": time.monotonic_ns(),
+                        "wall_ns": self.recorder._wall_ns_fn(),
+                        "node": self.node,
+                        "lost": self.lost,
+                    },
+                    separators=(",", ":"),
+                )
+            ]
+            for ev in events:
+                lines.append(json.dumps(ev, separators=(",", ":"), default=repr))
+            self._group.write(("\n".join(lines) + "\n").encode())
+            if sync:
+                self._group.sync()
+            else:
+                self._group.flush()
+            self._group.maybe_rotate()
+            # enforce the size cap on EVERY flush, not only at rotation:
+            # Group defers enforcement to rotate(), which lets the total
+            # overshoot by up to a head file between rotations — the
+            # spool's contract is a hard disk bound
+            self._group._enforce_group_limit()
+            if events:
+                self._watermark = events[-1]["seq"] + 1
+            else:
+                self._watermark = self.recorder._seq
+            self.flushes += 1
+            self.spooled += len(events)
+            return len(events)
+
+    def install_crash_hooks(self) -> None:
+        """Flush on interpreter exit and on an unhandled exception — the
+        crash classes a periodic task never gets to run for.  (SIGINT/
+        SIGTERM go through node.stop → close(); SIGKILL is covered only by
+        the cadence.)"""
+        if self._hooks_installed:
+            return
+        import atexit
+        import sys
+
+        atexit.register(self._crash_flush)
+        self._prev_excepthook = sys.excepthook
+
+        def hook(exc_type, exc, tb):
+            self._crash_flush()
+            (self._prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+        sys.excepthook = hook
+        self._hook_fn = hook
+        self._hooks_installed = True
+
+    def remove_crash_hooks(self) -> None:
+        if not self._hooks_installed:
+            return
+        import atexit
+        import sys
+
+        atexit.unregister(self._crash_flush)
+        # restore only if OUR hook object is still installed — another
+        # spool's hook (in-proc multi-node) or anything chained on top
+        # must not be uninstalled out from under its owner
+        if sys.excepthook is self._hook_fn and self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+        self._hooks_installed = False
+
+    def _crash_flush(self) -> None:
+        try:
+            self.flush(sync=True)
+        except Exception:  # noqa: BLE001 — never mask the original crash
+            pass
+
+    def close(self) -> None:
+        self.flush(sync=True)
+        with self._lock:
+            self._closed = True
+            self._group.close()
+        self.remove_crash_hooks()
+
+
+def spool_paths(head_path: str) -> List[str]:
+    """Rotated chunks (oldest first) + head — the on-disk read order for a
+    spool at `head_path`.  Standalone (no Group): reading a dead node's
+    spool must not open-for-append or touch the files."""
+    d = os.path.dirname(head_path) or "."
+    base = os.path.basename(head_path)
+    pat = re.compile(re.escape(base) + r"\.(\d{3,})$")
+    chunks = []
+    try:
+        for name in os.listdir(d):
+            m = pat.match(name)
+            if m:
+                chunks.append((int(m.group(1)), os.path.join(d, name)))
+    except FileNotFoundError:
+        return []
+    out = [p for _, p in sorted(chunks)]
+    if os.path.exists(head_path):
+        out.append(head_path)
+    return out
+
+
+def read_spool(path: str, name: str = "") -> dict:
+    """Offline spool replay → a dump-shaped dict (the same shape
+    `dump_flight_recorder` serves), so tracemerge / span_report / `debug
+    dump` work on a DEAD node's disk exactly like on a live node's RPC.
+
+    Torn-tail tolerant: a process killed mid-append leaves a partial (or
+    otherwise undecodable) final line — it is skipped and counted in
+    `torn`, and every decodable record before it is kept, the same
+    retained-suffix discipline as the mempool WAL replay.  `dropped`
+    reports events known to be missing from the replay (ring-wrap losses
+    recorded by the writer, rotated-away chunks, torn lines) so
+    span_report can classify prefix-truncated heights honestly.
+
+    The spool file survives restarts while recorder seqs restart at 0 per
+    process, so anchors carry a per-spool-session `run` id and the replay
+    SEGREGATES runs, returning the NEWEST (the crash under investigation —
+    earlier runs' events would otherwise collide on seq and replace the
+    evidence with stale data); `runs` reports how many sessions the file
+    holds."""
+    # per-run collection, runs in first-appearance (= file/time) order
+    run_events: "dict[str, dict]" = {}  # run -> {"events": {seq: ev}, "anchor", "node", "lost"}
+    run_order: List[str] = []
+    current: Optional[str] = None
+    pending: List[dict] = []  # events before the first surviving anchor
+    torn = 0
+
+    def _bucket(run: str) -> dict:
+        if run not in run_events:
+            run_events[run] = {"events": {}, "anchor": None, "node": "", "lost": 0}
+            run_order.append(run)
+        return run_events[run]
+
+    for p in spool_paths(path):
+        try:
+            with open(p, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            continue
+        for raw in data.split(b"\n"):
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except (ValueError, UnicodeDecodeError):
+                torn += 1
+                continue
+            if not isinstance(rec, dict):
+                torn += 1
+                continue
+            if rec.get("type") == "anchor":
+                current = str(rec.get("run", ""))
+                b = _bucket(current)
+                b["anchor"] = {"mono_ns": rec.get("mono_ns", 0),
+                               "wall_ns": rec.get("wall_ns", 0)}
+                b["node"] = rec.get("node") or b["node"]
+                b["lost"] = max(b["lost"], int(rec.get("lost", 0) or 0))
+                if pending:
+                    # events whose own anchor was rotated away belong to
+                    # the run of the FIRST surviving anchor (each flush
+                    # batch is anchor-first, so only a truncated batch
+                    # head lands here)
+                    for ev in pending:
+                        b["events"].setdefault(ev["seq"], ev)
+                    pending = []
+            elif "seq" in rec and "kind" in rec:
+                if current is None:
+                    pending.append(rec)
+                else:
+                    run_events[current]["events"].setdefault(rec["seq"], rec)
+            else:
+                torn += 1
+    if pending and not run_order:
+        _bucket("")["events"].update({ev["seq"]: ev for ev in pending})
+    # the NEWEST run is the one being investigated
+    chosen = run_events[run_order[-1]] if run_order else {
+        "events": {}, "anchor": None, "node": "", "lost": 0}
+    events = sorted(chosen["events"].values(), key=lambda ev: ev["seq"])
+    anchor, node, lost = chosen["anchor"], chosen["node"], chosen["lost"]
+    # seq holes in the replay cover every loss class at once: events never
+    # spooled (ring wrap — the writer's `lost` counter), rotated-away
+    # chunks, and pre-spool ring history; `first` is the evicted prefix
+    gaps = 0
+    for a, b in zip(events, events[1:]):
+        gaps += max(0, b["seq"] - a["seq"] - 1)
+    first = events[0]["seq"] if events else 0
+    return {
+        "enabled": True,
+        "source": "spool",
+        "node": name or node or os.path.splitext(os.path.basename(path))[0],
+        "size": len(events),
+        "next_seq": (events[-1]["seq"] + 1) if events else 0,
+        "since": 0,
+        "dropped": first + gaps + torn,
+        "torn": torn,
+        "writer_lost": lost,
+        "runs": len(run_order),
+        "anchor": anchor,
+        "events": events,
+    }

@@ -128,6 +128,8 @@ class Node(Service):
         self.metrics_server = None
         self.grpc_server = None
         self.loop_profiler = None
+        self.watchdog = None
+        self.flight_spool = None
         # flight recorder: always constructed (cheap), so the RPC dump
         # route exists whether or not prometheus is on; enabled/size/
         # high-rate sampling from the [instrumentation] config section
@@ -151,6 +153,23 @@ class Node(Service):
         from .crypto import backend as _crypto_backend
 
         self.metrics_provider.verify.backend_tier.set(_crypto_backend.active_tier())
+        # crash-persistent flight spool ([instrumentation] flight_spool):
+        # recorder events journal to disk on a cadence OFF the recording
+        # hot path, so a SIGKILL leaves the last seconds of spans for
+        # `debug dump` to replay offline.  Built before any service spawns
+        # so startup spans are covered too.
+        if cfg.instrumentation.flight_spool and self.flight_recorder.enabled:
+            from .libs.tracing import FlightSpool
+
+            cfg.ensure_dirs()
+            self.flight_spool = FlightSpool(
+                cfg.flight_spool_file(),
+                self.flight_recorder,
+                size_limit=cfg.instrumentation.flight_spool_size_limit,
+                node=cfg.base.moniker,
+            )
+            self.flight_spool.install_crash_hooks()
+            self.spawn(self._spool_flush_loop(), name="flight-spool")
         # scheduler profiler, started BEFORE any service spawns tasks so
         # the spawn-path accounting trampoline covers them all.  The spawn
         # and GC hooks are process-wide first-wins (libs/loopprof.py):
@@ -511,11 +530,56 @@ class Node(Service):
             self.log.info("prometheus metrics", laddr=self.metrics_server.bound_addr)
         if self.loop_profiler is not None:
             self._register_queue_probes()
+        # health watchdog, started LAST so every probed subsystem exists;
+        # serves /health and the /status health block, emits
+        # health.alarm/clear recorder events, auto-bundles on critical
+        if cfg.instrumentation.watchdog:
+            from .libs.watchdog import Watchdog, write_autodump_bundle
+
+            inst = cfg.instrumentation
+            autodump_fn = None
+            if inst.watchdog_autodump:
+                forensics_dir = cfg._join("data/forensics")
+
+                def autodump_fn(health):  # noqa: F811 — the armed variant
+                    return write_autodump_bundle(self, health, forensics_dir)
+
+            self.watchdog = Watchdog(
+                self,
+                interval=inst.watchdog_interval,
+                stall_seconds=inst.watchdog_stall_seconds,
+                round_churn=inst.watchdog_round_churn,
+                verify_stall_seconds=inst.watchdog_verify_stall_seconds,
+                lag_ms=inst.watchdog_lag_ms,
+                mempool_ratio=inst.watchdog_mempool_ratio,
+                shed_rate=inst.watchdog_shed_rate,
+                clock_drift_seconds=inst.watchdog_clock_drift_seconds,
+                min_peers=inst.watchdog_min_peers,
+                metrics=self.metrics_provider.health,
+                recorder=self.flight_recorder,
+                autodump_fn=autodump_fn,
+                autodump_min_interval=inst.watchdog_autodump_min_interval,
+            )
+            await self.watchdog.start()
         self.log.info(
             "node started",
             chain_id=self.genesis_doc.chain_id,
             height=self.state.last_block_height,
         )
+
+    async def _spool_flush_loop(self) -> None:
+        """Cadence flush of the flight spool — small buffered appends, far
+        from the recording hot path (the recorder never knows the spool
+        exists).  Crash classes: this loop covers the steady state; the
+        excepthook/atexit hooks cover crashes; node stop does the final
+        synced flush; SIGKILL keeps everything up to the last cadence."""
+        interval = self.config.instrumentation.flight_spool_flush_interval
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self.flight_spool.flush()
+            except Exception as e:  # noqa: BLE001 — a full disk must not kill consensus
+                self.log.error("flight spool flush failed", err=repr(e))
 
     def _register_queue_probes(self) -> None:
         """Wire the known choke-point queues into the scheduler profiler's
@@ -574,6 +638,8 @@ class Node(Service):
         await self.blockchain_reactor.switch_to_fastsync(self.state)
 
     async def on_stop(self) -> None:
+        if self.watchdog is not None:
+            await self.watchdog.stop()
         if self.loop_profiler is not None:
             await self.loop_profiler.stop()
         if self.metrics_server is not None:
@@ -607,3 +673,7 @@ class Node(Service):
                 and batch_hook.get_indexed_verifier() == self.table_cache.verify_indexed
             ):
                 batch_hook.set_indexed_verifier(None)
+        if self.flight_spool is not None:
+            # final synced flush AFTER everything above recorded its last
+            # events; an orderly stop leaves a complete spool
+            self.flight_spool.close()

@@ -65,6 +65,11 @@ class Switch(Service):
         self.addr_book = None
         self._reconnecting: set = set()
         self._connecting: set = set()
+        # ids whose stop is in flight: a replacement connection must not be
+        # admitted until the old peer's reactor teardown completes, or the
+        # deferred remove_peer would tear down the REPLACEMENT's state
+        # (same id, different object) and wedge gossip to a live peer
+        self._stopping: set = set()
         self._admitting_inbound: List = []  # (node_id, ip) in-flight tokens
         from ..libs.metrics import P2PMetrics
 
@@ -176,8 +181,15 @@ class Switch(Service):
         self, conn, ni: NodeInfo, outbound: bool, persistent: bool = False, addr: str = ""
     ) -> Optional[Peer]:
         # reserve the id synchronously — simultaneous inbound+outbound to the
-        # same peer must not both pass the check across the awaits below
-        if ni.node_id in self.peers or ni.node_id in self._connecting:
+        # same peer must not both pass the check across the awaits below.
+        # An id mid-STOP is refused too: admitting now would let the old
+        # peer's deferred teardown destroy the new peer's reactor state
+        # (the remote's persistent redial retries in milliseconds).
+        if (
+            ni.node_id in self.peers
+            or ni.node_id in self._connecting
+            or ni.node_id in self._stopping
+        ):
             conn.close()
             return self.peers.get(ni.node_id)
         self._connecting.add(ni.node_id)
@@ -257,7 +269,10 @@ class Switch(Service):
         mconn.stop() await the cancellation of the very task this call
         chain is suspended in — a cycle only the 10 s stop timeout breaks,
         parking a half-stopped peer past test/node teardown."""
-        if peer.id not in self.peers:
+        if self.peers.get(peer.id) is not peer:
+            # identity, not membership: the table entry may already be a
+            # NEWER connection with the same id — its state is not ours
+            # to touch
             return
         self.log.info("stopping peer for error", peer=peer.id[:12], err=reason)
         if self.addr_book is not None:
@@ -283,7 +298,7 @@ class Switch(Service):
             self._maybe_reconnect(peer.id)
 
     async def _finish_stop_peer(self, peer: Peer, reason: str) -> None:
-        if peer.id not in self.peers:
+        if self.peers.get(peer.id) is not peer:
             return  # a second conn-task error already detached a stop
         await self._stop_and_remove_peer(peer, reason)
         if peer.persistent:
@@ -293,12 +308,28 @@ class Switch(Service):
         await self._stop_and_remove_peer(peer, None)
 
     async def _stop_and_remove_peer(self, peer: Peer, reason: Optional[str]) -> None:
-        self.peers.pop(peer.id, None)
-        self.metrics.peers.set(len(self.peers))
-        if peer.is_running:
-            await peer.stop()
-        for reactor in self.reactors.values():
-            await reactor.remove_peer(peer, reason)
+        if self.peers.get(peer.id) is not peer:
+            # a replacement connection owns the slot (or it is already
+            # gone): stop THIS object only — popping the table / calling
+            # reactor.remove_peer here would tear down the replacement's
+            # per-peer state and leave a live connection with no gossip
+            # routines (measured: a 2-val net wedged at height 0 forever)
+            if peer.is_running:
+                await peer.stop()
+            return
+        # hold the id until reactor teardown completes: peer.stop() and
+        # reactor.remove_peer await, and a new connection with this id
+        # admitted in between would be destroyed by OUR teardown
+        self._stopping.add(peer.id)
+        try:
+            self.peers.pop(peer.id, None)
+            self.metrics.peers.set(len(self.peers))
+            if peer.is_running:
+                await peer.stop()
+            for reactor in self.reactors.values():
+                await reactor.remove_peer(peer, reason)
+        finally:
+            self._stopping.discard(peer.id)
 
     def _maybe_reconnect(self, peer_id: str) -> None:
         addr = self.persistent_addrs.get(peer_id)

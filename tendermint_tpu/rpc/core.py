@@ -129,6 +129,11 @@ class RPCCore:
         self._buckets: "collections.OrderedDict[str, TokenBucket]" = collections.OrderedDict()
         self._inflight = 0
         self._commit_waiters = 0
+        # plain rejection counter beside the labeled prometheus one: the
+        # health watchdog reads it each tick — sustained shedding IS
+        # degradation, even when every queue the QoS layer guards stays
+        # comfortably bounded (that is the QoS layer working)
+        self.throttled_total = 0
         from ..libs.metrics import RPCMetrics
         from ..libs.tracing import NOP as _NOP_RECORDER
 
@@ -202,6 +207,17 @@ class RPCCore:
 
     # -- ingress admission control ----------------------------------------
 
+    def _shed(self, reason: str, source: str = "") -> None:
+        """One bookkeeping point for every explicit overload rejection:
+        the labeled metric, the (sampled) recorder event, and the plain
+        counter the watchdog's ingress_shedding detector rates."""
+        self.throttled_total += 1
+        self.metrics.throttled.labels(reason=reason).inc()
+        if source:
+            self.recorder.record_sampled("ingress.throttle", reason=reason, source=source)
+        else:
+            self.recorder.record_sampled("ingress.throttle", reason=reason)
+
     def _throttle_broadcast(self, source: str) -> None:
         """Per-source token bucket over the broadcast routes.  A source-
         less call (in-proc LocalClient, tests) is trusted — the global
@@ -218,8 +234,7 @@ class RPCCore:
             self._buckets.move_to_end(source)
         if not bucket.allow():
             retry = bucket.retry_after()
-            self.metrics.throttled.labels(reason="rate").inc()
-            self.recorder.record_sampled("ingress.throttle", reason="rate", source=source)
+            self._shed("rate", source)
             raise overloaded_error(
                 f"per-source broadcast rate limit ({self.broadcast_rate:g} tx/s) exceeded",
                 retry,
@@ -229,8 +244,7 @@ class RPCCore:
         """Claim a slot in the bounded in-flight broadcast queue; reject —
         never queue silently — when it is full."""
         if 0 < self.max_broadcast_inflight <= self._inflight:
-            self.metrics.throttled.labels(reason="inflight").inc()
-            self.recorder.record_sampled("ingress.throttle", reason="inflight")
+            self._shed("inflight")
             raise overloaded_error(
                 f"{self._inflight} broadcasts in flight (cap "
                 f"{self.max_broadcast_inflight})",
@@ -246,7 +260,15 @@ class RPCCore:
     # -- info routes -------------------------------------------------------
 
     async def health(self) -> dict:
-        return {}
+        """rpc/core/health.go returned a bare `{}`; with the watchdog on
+        (libs/watchdog.py) the route serves the aggregate verdict plus the
+        active alarms with operator-readable reasons — load-balancer-
+        friendly: route away from anything whose `ok` is false.  Without a
+        watchdog the reference's empty object survives."""
+        wd = getattr(self.node, "watchdog", None)
+        if wd is None:
+            return {}
+        return wd.health()
 
     async def status(self) -> dict:
         """rpc/core/status.go:32."""
@@ -294,11 +316,19 @@ class RPCCore:
                 "pub_key": pub.bytes(),
                 "voting_power": power,
             }
-        return {
+        out = {
             "node_info": self._node_info(),
             "sync_info": sync_info,
             "validator_info": validator_info,
         }
+        # health summary (verdict + active alarm names): readiness gates
+        # and load rigs already poll /status — they can now assert the
+        # node SELF-reports degradation instead of inferring it
+        wd = getattr(node, "watchdog", None)
+        if wd is not None:
+            h = wd.health()
+            out["health"] = {"verdict": h["verdict"], "alarms": sorted(h["alarms"])}
+        return out
 
     def _node_info(self) -> dict:
         node = self.node
@@ -560,8 +590,7 @@ class RPCCore:
             # client code 0 up front, so telemetry is the only signal left
             exc = t.exception()
             if isinstance(exc, MempoolFullError):
-                self.metrics.throttled.labels(reason="mempool_full").inc()
-                self.recorder.record_sampled("ingress.throttle", reason="mempool_full")
+                self._shed("mempool_full")
 
         task.add_done_callback(_done)
         return {"code": 0, "data": b"", "log": "", "hash": tx_hash(tx)}
@@ -572,8 +601,7 @@ class RPCCore:
         try:
             res = await self.node.mempool.check_tx(tx)
         except MempoolFullError as e:
-            self.metrics.throttled.labels(reason="mempool_full").inc()
-            self.recorder.record_sampled("ingress.throttle", reason="mempool_full")
+            self._shed("mempool_full")
             raise overloaded_error(str(e), 1.0)
         finally:
             self._release_inflight()
@@ -592,8 +620,7 @@ class RPCCore:
         timeout_broadcast_tx_commit, so under a commit stall an uncapped
         route would pile subscriptions onto the bus without bound."""
         if 0 < self.max_commit_waiters <= self._commit_waiters:
-            self.metrics.throttled.labels(reason="commit_waiters").inc()
-            self.recorder.record_sampled("ingress.throttle", reason="commit_waiters")
+            self._shed("commit_waiters")
             raise overloaded_error(
                 f"{self._commit_waiters} broadcast_tx_commit waiters (cap "
                 f"{self.max_commit_waiters})",
@@ -619,8 +646,7 @@ class RPCCore:
             try:
                 check = await self.node.mempool.check_tx(tx)
             except MempoolFullError as e:
-                self.metrics.throttled.labels(reason="mempool_full").inc()
-                self.recorder.record_sampled("ingress.throttle", reason="mempool_full")
+                self._shed("mempool_full")
                 raise overloaded_error(str(e), 1.0)
             finally:
                 self._release_inflight()

@@ -161,3 +161,86 @@ class TestTestnet:
             for n in nodes:
                 if n.is_running:
                     await n.stop()
+
+
+class TestDebugBundles:
+    """`debug dump --offline`: a dead node's forensics bundle built purely
+    from its home directory — the spool replay stands in for the live
+    recorder, and the derived span report proves the pre-crash chains."""
+
+    def _crashed_home(self, tmp_path, heights=6):
+        from tendermint_tpu.libs.tracing import FlightRecorder, FlightSpool
+
+        home = str(tmp_path / "home")
+        run_cli("--home", home, "init", "--chain-id", "dbg-chain")
+        cfg = load_config(os.path.join(home, "config", "config.toml"), home=home)
+        rec = FlightRecorder(size=8192)
+        sp = FlightSpool(cfg.flight_spool_file(), rec, node="dbg-node")
+        for h in range(1, heights + 1):
+            for s in ("Propose", "Prevote", "Precommit", "Commit"):
+                rec.record("step", height=h, round=0, step=s)
+            rec.record("commit", height=h, txs=0, block=f"h{h}")
+            sp.flush()
+        # NO close(): the node was SIGKILLed — the spool is all there is
+        return home
+
+    def test_debug_dump_offline_reconstructs_from_spool(self, tmp_path, capsys):
+        import tarfile
+
+        home = self._crashed_home(tmp_path)
+        out = str(tmp_path / "bundles")
+        assert run_cli(
+            "--home", home, "debug", "dump", "--offline", "--output", out
+        ) == 0
+        capsys.readouterr()
+        bundles = [f for f in os.listdir(out) if f.endswith(".tar.gz")]
+        assert len(bundles) == 1
+        sections = {}
+        with tarfile.open(os.path.join(out, bundles[0])) as tar:
+            for m in tar.getmembers():
+                sections[os.path.basename(m.name)] = tar.extractfile(m).read()
+        assert {"manifest.json", "config.toml", "spool.json",
+                "span_report.json", "loop_report.json",
+                "flight.spool.tail"} <= set(sections)
+        manifest = json.loads(sections["manifest.json"])
+        assert manifest["mode"] == "offline"
+        assert manifest["event_source"] == "spool"
+        # the acceptance shape: every interior pre-crash height has a
+        # complete propose→prevote→precommit→commit chain, from disk alone
+        rep = json.loads(sections["span_report.json"])
+        assert rep["bad"] == {} and rep["interior"] == 4
+        assert len(rep["complete"]) == rep["interior"]
+        spool = json.loads(sections["spool.json"])
+        assert spool["node"] == "dbg-node" and spool["events"]
+        # offline mode never touched the RPC sections
+        assert "status.json" not in sections
+
+    def test_debug_dump_periodic_count(self, tmp_path, capsys):
+        home = self._crashed_home(tmp_path, heights=3)
+        out = str(tmp_path / "periodic")
+        assert run_cli(
+            "--home", home, "debug", "dump", "--offline", "--output", out,
+            "--frequency", "0.05", "--count", "2",
+        ) == 0
+        capsys.readouterr()
+        assert len([f for f in os.listdir(out) if f.endswith(".tar.gz")]) == 2
+
+    def test_debug_dump_live_degrades_to_home_dir_when_rpc_dead(
+        self, tmp_path, capsys
+    ):
+        import tarfile
+
+        home = self._crashed_home(tmp_path, heights=3)
+        out = str(tmp_path / "degraded")
+        # no --offline, but nothing listens on the laddr: the bundle must
+        # still be written from the home dir, with the RPC failure noted
+        assert run_cli(
+            "--home", home, "debug", "dump", "--output", out,
+            "--rpc-laddr", "127.0.0.1:1",
+        ) == 0
+        capsys.readouterr()
+        bundles = [f for f in os.listdir(out) if f.endswith(".tar.gz")]
+        assert len(bundles) == 1
+        with tarfile.open(os.path.join(out, bundles[0])) as tar:
+            names = {os.path.basename(m.name) for m in tar.getmembers()}
+        assert "spool.json" in names and "config.toml" in names

@@ -774,3 +774,87 @@ class TestMempoolSigPrecheck:
         assert payload == b"hello=world"
         assert k.pub_key().verify(sign_bytes, sig)
         assert parse_signed_tx(b"not an envelope") is None
+
+
+class TestRoundStateReannounce:
+    """Liveness repair pinned: NewRoundStep is normally sent only on step
+    transitions and add_peer, so a message-level partition (connections
+    up, frames dropped) that straddles a height transition leaves both
+    sides' PeerRoundState beliefs stale forever — post-heal vote pushes
+    then target the wrong height and a healed net stays wedged (measured
+    on the forensics rig: Precommit with 2/4 precommits for 70+ s).  The
+    maj23 tick now re-announces our round state when it changed since the
+    last announce this peer acked, and keeps re-announcing at a slow
+    repair cadence while the peer still looks desynced."""
+
+    async def _run_ticks(self, reactor, peer, ps, seconds):
+        task = asyncio.ensure_future(reactor._query_maj23_routine(peer, ps))
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    async def test_reannounces_to_desynced_peer_then_dedupes(self):
+        vset, _ = _vset_and_votes(4)
+        cs = _FakeCS(vset)
+        cs.config.peer_query_maj23_sleep_duration = 0.02
+        reactor = ConsensusReactor(cs)
+        peer = _CapturePeer("reannounce-peer")
+        ps = PeerRoundState()  # fresh: believes height 0 — desynced
+        await self._run_ticks(reactor, peer, ps, 0.1)
+        nrs = [d for _, k, d, _ in peer.sent if k == "new_round_step"]
+        assert nrs, "a desynced peer must get our round state re-announced"
+        assert nrs[0]["height"] == cs.rs.height
+        # value-deduped: several ticks, ONE announce (state unchanged and
+        # the send succeeded — no idle chatter on a healthy net)
+        assert len(nrs) == 1
+        # the peer syncing (applying the announce) keeps it deduped
+        ps.apply_new_round_step(nrs[0])
+        peer.sent.clear()
+        await self._run_ticks(reactor, peer, ps, 0.08)
+        assert "new_round_step" not in peer.kinds()
+        # our state moving re-arms the announce
+        cs.rs.round += 1
+        await self._run_ticks(reactor, peer, ps, 0.08)
+        assert "new_round_step" in peer.kinds()
+
+    async def test_desynced_peer_gets_slow_cadence_repair_resends(self):
+        vset, _ = _vset_and_votes(4)
+        cs = _FakeCS(vset)
+        cs.config.peer_query_maj23_sleep_duration = 0.01
+        reactor = ConsensusReactor(cs)
+        peer = _CapturePeer("repair-peer-000")
+        ps = PeerRoundState()  # never applies the announce: stays desynced
+        await self._run_ticks(reactor, peer, ps, 0.35)
+        nrs = [1 for _, k, _, _ in peer.sent if k == "new_round_step"]
+        # resend floor is 10 ticks: ~0.35 s of 0.01 s ticks means the
+        # stuck-desynced peer saw a few repair re-announces, not a flood
+        assert 2 <= len(nrs) <= 5, f"expected slow-cadence resends, got {len(nrs)}"
+
+    async def test_failed_send_is_retried_next_tick(self):
+        vset, _ = _vset_and_votes(4)
+        cs = _FakeCS(vset)
+        cs.config.peer_query_maj23_sleep_duration = 0.02
+        reactor = ConsensusReactor(cs)
+
+        class _DropThenOk(_CapturePeer):
+            def __init__(self):
+                super().__init__("flaky-peer-0000")
+                self.fail = 2
+
+            async def send(self, chan, msg):
+                if self.fail > 0:
+                    self.fail -= 1
+                    return False  # partitioned: the frame is dropped
+                return await super().send(chan, msg)
+
+        peer = _DropThenOk()
+        ps = PeerRoundState()
+        await self._run_ticks(reactor, peer, ps, 0.15)
+        # dropped announces must not be marked acked — the first
+        # SUCCESSFUL send lands as soon as the link heals
+        assert "new_round_step" in peer.kinds()

@@ -206,3 +206,107 @@ class TestSwitch:
             assert peer is None
         finally:
             await stop_switches([sw1, sw2])
+
+
+class TestPeerReplacementRace:
+    """The 2-val wedge class (found by the health watchdog's stall alarm):
+    a peer stop awaits mid-teardown, a replacement connection with the
+    SAME id lands in the window, and the deferred reactor.remove_peer
+    used to destroy the replacement's gossip state — a live connection
+    with no routines, a net stalled at height 0 forever.  The switch now
+    (a) identity-guards every stop path and (b) refuses to admit an id
+    whose stop is still in flight."""
+
+    async def test_stop_holds_id_and_blocks_readmission_until_teardown(self):
+        calls = {"add": [], "remove": []}
+
+        class Recording(EchoReactor):
+            async def add_peer(self, peer):
+                calls["add"].append(peer)
+
+            async def remove_peer(self, peer, reason=None):
+                calls["remove"].append(peer)
+
+        sw1, sw2 = make_switch(), make_switch()
+        sw1.add_reactor("echo", Recording())
+        sw2.add_reactor("echo", EchoReactor())
+        addr1 = await start_switch(sw1)
+        await start_switch(sw2)
+        # a third transport with sw2's IDENTITY: the replacement dialer
+        nk2 = sw2.transport.node_key
+        sw3 = Switch(
+            Transport(nk2, NodeInfo(node_id=nk2.id, network="test-net", moniker="twin"))
+        )
+        sw3.add_reactor("echo", EchoReactor())
+        await start_switch(sw3)
+        try:
+            await connect_switches(sw2, sw1)
+            peer1 = sw1.peers[sw2.node_id]
+            assert calls["add"] == [peer1]
+
+            # park the stop mid-teardown: the exact window the race needs
+            gate = asyncio.Event()
+            orig_stop = peer1.stop
+
+            async def slow_stop():
+                await gate.wait()
+                await orig_stop()
+
+            peer1.stop = slow_stop
+            kick = asyncio.ensure_future(sw1.stop_peer_for_error(peer1, "kick"))
+            await asyncio.sleep(0.05)
+            assert sw2.node_id in sw1._stopping
+            assert sw2.node_id not in sw1.peers
+
+            # the replacement dial during the window must be REFUSED, not
+            # admitted into a table the parked teardown will tear down
+            await sw3.dial_peer(f"{sw1.node_id}@{sw1.transport.listen_addr}")
+            await asyncio.sleep(0.05)
+            assert sw2.node_id not in sw1.peers
+            assert calls["add"] == [peer1], "no add during the stop window"
+
+            gate.set()
+            await kick
+            assert calls["remove"] == [peer1]
+            assert sw2.node_id not in sw1._stopping
+
+            # once teardown completed, the same identity reconnects and
+            # gets FRESH reactor state
+            await connect_switches(sw3, sw1)
+            assert len(calls["add"]) == 2
+            assert calls["add"][1] is sw1.peers[sw2.node_id]
+            assert calls["add"][1] is not peer1
+        finally:
+            await stop_switches([sw1, sw2, sw3])
+
+    async def test_stale_peer_stop_never_touches_replacement_state(self):
+        removed = []
+
+        class Recording(EchoReactor):
+            async def remove_peer(self, peer, reason=None):
+                removed.append(peer)
+
+        sw1, sw2 = make_switch(), make_switch()
+        sw1.add_reactor("echo", Recording())
+        sw2.add_reactor("echo", EchoReactor())
+        await start_switch(sw1)
+        await start_switch(sw2)
+        try:
+            await connect_switches(sw2, sw1)
+            peer1 = sw1.peers[sw2.node_id]
+            # simulate the table slot already owned by a replacement
+            sentinel = object()
+            sw1.peers[sw2.node_id] = sentinel
+            await sw1.stop_peer_for_error(peer1, "stale kick")
+            await asyncio.sleep(0.05)
+            # the stale stop must neither pop the slot nor reach reactors
+            assert sw1.peers[sw2.node_id] is sentinel
+            assert removed == []
+            # graceful path too: stops the object, leaves the slot alone
+            await sw1.stop_peer_gracefully(peer1)
+            assert sw1.peers[sw2.node_id] is sentinel
+            assert removed == []
+            assert not peer1.is_running
+        finally:
+            del sw1.peers[sw2.node_id]  # drop the sentinel before teardown
+            await stop_switches([sw1, sw2])

@@ -352,3 +352,71 @@ class TestInProcNet:
         # the one-line summary (median per key across heights) exists —
         # note per-KEY medians need not sum to exactly 100
         assert tracemerge.median_attribution(by_height) is not None
+
+
+class TestSpoolIngest:
+    """Offline forensics: load_dump reads a crash spool (the JSON-lines
+    journal a SIGKILLed node leaves behind) and merges it with live RPC
+    dumps — the dead node appears on the causal timeline like any other."""
+
+    def _write_spool(self, path, node, heights):
+        from tendermint_tpu.libs.tracing import FlightSpool
+
+        rec = FlightRecorder(size=8192)
+        sp = FlightSpool(str(path), rec, node=node)
+        for h in heights:
+            rec.record("proposal", height=h, round=0, src="self")
+            for step in ("Propose", "Prevote", "Precommit", "Commit"):
+                rec.record("step", height=h, round=0, step=step)
+            rec.record("commit", height=h, txs=0, block=f"hash{h}")
+            sp.flush()
+        # no close(): the node was SIGKILLed
+        return rec
+
+    def test_load_dump_reads_spool_and_merges_with_live_dump(self, tmp_path):
+        spool_path = tmp_path / "flight.spool"
+        rec = self._write_spool(spool_path, "dead-node", [1, 2, 3, 4])
+        d = tracemerge.load_dump(str(spool_path))
+        assert d["node"] == "dead-node" and d.get("source") == "spool"
+        assert len(d["events"]) == len(rec.events())
+        # a live peer's snapshot of the same run (same hashes, own anchor)
+        live = FlightRecorder(size=8192)
+        for h in [1, 2, 3, 4, 5]:
+            live.record("proposal", height=h, round=0, src="self")
+            for step in ("Propose", "Prevote", "Precommit", "Commit"):
+                live.record("step", height=h, round=0, step=step)
+            live.record("commit", height=h, txs=0, block=f"hash{h}")
+        snap = live.snapshot()
+        snap["node"] = "live-node"
+        merged = tracemerge.merge([d, snap])
+        assert set(merged["nodes"]) == {"dead-node", "live-node"}
+        shared = [h for h, e in merged["heights"].items()
+                  if {"dead-node", "live-node"} <= set(e["nodes"])]
+        assert len(shared) == 4
+        assert merged["hash_mismatch_heights"] == []
+        # the dead node's chains pass the trace gate (no attribution
+        # required: its profiler died with it)
+        failures = tracemerge.check([d, snap], merged, require_attribution=False)
+        assert failures == []
+
+    def test_torn_spool_still_loads(self, tmp_path):
+        spool_path = tmp_path / "flight.spool"
+        self._write_spool(spool_path, "torn-node", [1, 2, 3])
+        import os
+
+        size = os.path.getsize(spool_path)
+        with open(spool_path, "r+b") as f:
+            f.truncate(size - 9)  # kill landed mid-append
+        d = tracemerge.load_dump(str(spool_path))
+        assert d["node"] == "torn-node"
+        assert d["torn"] == 1 and len(d["events"]) >= 3 * 6 - 1
+
+    def test_name_override_and_non_spool_rejection(self, tmp_path):
+        spool_path = tmp_path / "flight.spool"
+        self._write_spool(spool_path, "x", [1])
+        d = tracemerge.load_dump(str(spool_path), name="renamed")
+        assert d["node"] == "renamed"
+        junk = tmp_path / "junk.txt"
+        junk.write_text("not json\nat all\n")
+        with pytest.raises(ValueError):
+            tracemerge.load_dump(str(junk))

@@ -2,6 +2,7 @@
 span-chain analysis, the dump_flight_recorder RPC route and the
 verify-engine event stream."""
 
+import os
 import time
 
 from tendermint_tpu.libs import tracing
@@ -292,3 +293,227 @@ class TestVerifyEngineEvents:
         assert flush["batch"] >= 1 and flush["wait_ms"] >= 0
         dispatch = next(e for e in rec.events() if e["kind"] == "verify.dispatch")
         assert dispatch["path"] == "host" and dispatch["n"] >= 1
+
+
+class TestFlightSpool:
+    """Crash-persistent spool ([instrumentation] flight_spool): rotation
+    under the size cap, torn-tail-tolerant replay, wrap accounting, and
+    the hot-path contract (the recorder never pays for the spool)."""
+
+    def _steps(self, rec, heights, round_=0):
+        for h in heights:
+            for s in ("Propose", "Prevote", "Precommit", "Commit"):
+                rec.record("step", height=h, step=s, round=round_)
+            rec.record("commit", height=h, txs=0, block="ab")
+
+    def test_roundtrip_replay_matches_ring(self, tmp_path):
+        from tendermint_tpu.libs.tracing import FlightSpool, read_spool
+
+        rec = FlightRecorder(size=4096)
+        sp = FlightSpool(str(tmp_path / "flight.spool"), rec, node="n7")
+        self._steps(rec, range(1, 8))
+        sp.flush()
+        sp.close()
+        dump = read_spool(str(tmp_path / "flight.spool"))
+        assert dump["node"] == "n7" and dump["source"] == "spool"
+        assert dump["dropped"] == 0 and dump["torn"] == 0
+        assert [e["seq"] for e in dump["events"]] == [
+            e["seq"] for e in rec.events()
+        ]
+        assert dump["anchor"] is not None and dump["anchor"]["wall_ns"] > 0
+        rep = tracing.span_report(dump["events"], dropped=dump["dropped"])
+        assert rep["bad"] == {} and len(rep["complete"]) == rep["interior"] == 5
+
+    def test_torn_tail_kill_mid_append_keeps_retained_suffix(self, tmp_path):
+        """Simulate a SIGKILL landing mid-write: the final record is cut
+        at an arbitrary byte.  Replay must keep every complete record,
+        count the torn line, and span_report must stay clean."""
+        from tendermint_tpu.libs.tracing import FlightSpool, read_spool
+
+        path = str(tmp_path / "flight.spool")
+        rec = FlightRecorder(size=4096)
+        sp = FlightSpool(path, rec, node="torn")
+        self._steps(rec, range(1, 6))
+        sp.flush()
+        # the spool is abandoned un-closed (the kill); chop the file tail
+        # mid-record instead of at a line boundary
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - 7)
+        dump = read_spool(path)
+        assert dump["torn"] == 1
+        # every complete line survived: only the final record was cut
+        assert len(dump["events"]) >= 5 * 5 - 1
+        rep = tracing.span_report(dump["events"], dropped=dump["dropped"])
+        assert rep["bad"] == {}
+        # garbage bytes appended by a dying disk are skipped the same way
+        with open(path, "ab") as f:
+            # leading newline: the truncated line above has no terminator,
+            # so raw bytes would otherwise merge into the same torn line
+            f.write(b"\n\xff\xfe{{{ not json\n")
+        dump2 = read_spool(path)
+        assert dump2["torn"] == 2
+        assert len(dump2["events"]) == len(dump["events"])
+
+    def test_rotation_bounds_disk_and_reports_dropped_prefix(self, tmp_path):
+        from tendermint_tpu.libs.tracing import FlightSpool, read_spool, spool_paths
+
+        path = str(tmp_path / "flight.spool")
+        rec = FlightRecorder(size=1 << 16)
+        cap = 16 * 1024
+        sp = FlightSpool(path, rec, size_limit=cap, node="rot")
+        for h in range(1, 200):
+            self._steps(rec, [h])
+            sp.flush()
+        sp.close()
+        total = sum(os.path.getsize(p) for p in spool_paths(path))
+        assert total <= cap, f"spool grew past its cap: {total} > {cap}"
+        dump = read_spool(path)
+        assert dump["dropped"] > 0  # rotated-away prefix is reported
+        assert dump["events"], "the retained suffix must replay"
+        # the newest heights survived (oldest-first eviction)
+        rep = tracing.span_report(dump["events"], dropped=dump["dropped"])
+        assert rep["bad"] == {}, "rotation must only ever truncate a PREFIX"
+        assert 198 in tracing.step_chains(dump["events"])
+
+    def test_ring_wrap_between_flushes_is_accounted(self, tmp_path):
+        from tendermint_tpu.libs.tracing import FlightSpool, read_spool
+
+        rec = FlightRecorder(size=8)
+        sp = FlightSpool(str(tmp_path / "w.spool"), rec, node="w")
+        for i in range(30):
+            rec.record("x", i=i)
+        sp.flush()
+        for i in range(30):
+            rec.record("y", i=i)
+        sp.flush()
+        sp.close()
+        dump = read_spool(str(tmp_path / "w.spool"))
+        assert len(dump["events"]) == 16  # two ring-fulls
+        assert dump["writer_lost"] == 22  # wrap losses the writer observed
+        assert dump["dropped"] == 60 - 16  # replay holes cover all classes
+
+    def test_record_hot_path_unchanged_with_spool_attached(self, tmp_path):
+        """The acceptance tripwire: spool writes happen OFF the recording
+        path — record() with a spool attached stays under the same 5 µs
+        budget the bare recorder is held to."""
+        from tendermint_tpu.libs.tracing import FlightSpool
+
+        rec = FlightRecorder(size=8192)
+        sp = FlightSpool(str(tmp_path / "hot.spool"), rec, node="hot")
+        n = 20_000
+        t0 = time.perf_counter()
+        for i in range(n):
+            rec.record("step", height=i, step="Propose", round=0)
+        per_event = (time.perf_counter() - t0) / n
+        sp.flush()
+        sp.close()
+        assert per_event < 5e-6, (
+            f"record() with spool enabled took {per_event * 1e6:.2f} us/event"
+        )
+
+    def test_flush_idempotent_and_empty_flush_writes_nothing(self, tmp_path):
+        from tendermint_tpu.libs.tracing import FlightSpool
+
+        path = str(tmp_path / "idle.spool")
+        rec = FlightRecorder(size=64)
+        sp = FlightSpool(path, rec, node="idle")
+        rec.record("step", height=1, step="Propose")
+        assert sp.flush() == 1
+        size_after = os.path.getsize(path)
+        # nothing new: no bytes written (an idle node must not grow its
+        # spool with anchor-only batches every flush interval)
+        assert sp.flush() == 0
+        sp._group.flush()
+        assert os.path.getsize(path) == size_after
+        sp.close()
+
+    def test_crash_hooks_flush_on_excepthook(self, tmp_path):
+        import sys
+
+        from tendermint_tpu.libs.tracing import FlightSpool, read_spool
+
+        path = str(tmp_path / "hook.spool")
+        rec = FlightRecorder(size=64)
+        sp = FlightSpool(path, rec, node="hook")
+        sp.install_crash_hooks()
+        try:
+            rec.record("step", height=1, step="Propose")
+            # simulate the interpreter's unhandled-exception path
+            try:
+                raise RuntimeError("boom")
+            except RuntimeError:
+                sys.excepthook(*sys.exc_info())
+            dump = read_spool(path)
+            assert len(dump["events"]) == 1, "excepthook must flush the spool"
+        finally:
+            sp.close()
+        assert sys.excepthook is sys.__excepthook__ or not hasattr(
+            sys.excepthook, "__self__"
+        )
+
+    def test_recorder_dropped_property(self):
+        rec = FlightRecorder(size=4)
+        assert rec.dropped == 0
+        for i in range(10):
+            rec.record("x", i=i)
+        assert rec.dropped == 6
+
+    def test_two_spools_crash_hooks_are_independent(self, tmp_path):
+        """In-proc multi-node: removing spool A's crash hook must not
+        uninstall spool B's (the excepthook chain is per-object, and only
+        the OWNING hook may be restored away)."""
+        import sys
+
+        from tendermint_tpu.libs.tracing import FlightSpool, read_spool
+
+        rec_a, rec_b = FlightRecorder(size=64), FlightRecorder(size=64)
+        sp_a = FlightSpool(str(tmp_path / "a.spool"), rec_a, node="a")
+        sp_b = FlightSpool(str(tmp_path / "b.spool"), rec_b, node="b")
+        sp_a.install_crash_hooks()
+        sp_b.install_crash_hooks()
+        try:
+            sp_a.close()  # removes A's hooks; B's chain must survive
+            assert sys.excepthook is sp_b._hook_fn, (
+                "closing spool A must not uninstall spool B's crash hook"
+            )
+            rec_b.record("step", height=1, step="Propose")
+            try:
+                raise RuntimeError("boom")
+            except RuntimeError:
+                sys.excepthook(*sys.exc_info())
+            assert len(read_spool(str(tmp_path / "b.spool"))["events"]) == 1
+        finally:
+            sp_b.close()
+
+    def test_restart_reuses_spool_but_replay_returns_newest_run(self, tmp_path):
+        """The spool file survives restarts (append-mode head) while
+        recorder seqs restart at 0 per process — the replay must return
+        the NEWEST run's events, not let the old run's colliding seqs
+        replace the crash evidence with stale data."""
+        from tendermint_tpu.libs.tracing import FlightSpool, read_spool
+
+        path = str(tmp_path / "flight.spool")
+        # run 1: heights 1-5, clean stop
+        rec1 = FlightRecorder(size=4096)
+        sp1 = FlightSpool(path, rec1, node="boot1")
+        self._steps(rec1, range(1, 6))
+        sp1.flush()
+        sp1.close()
+        # run 2 (restart, same home): heights 100-102, SIGKILLed
+        rec2 = FlightRecorder(size=4096)
+        sp2 = FlightSpool(path, rec2, node="boot2")
+        self._steps(rec2, range(100, 103))
+        sp2.flush()  # no close: the crash
+        dump = read_spool(path)
+        assert dump["runs"] == 2
+        assert dump["node"] == "boot2"
+        heights = {e.get("height") for e in dump["events"] if e["kind"] == "step"}
+        assert heights == {100, 101, 102}, (
+            f"replay must carry the crashing run's heights, got {heights}"
+        )
+        assert len(dump["events"]) == len(rec2.events())
+        # legacy single-run spools (and every earlier test) keep working:
+        # a one-run file reports runs == 1 with identical semantics
+        solo = read_spool(str(tmp_path / "flight.spool") + ".none")
+        assert solo["events"] == [] and solo["runs"] == 0

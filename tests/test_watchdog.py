@@ -1,0 +1,363 @@
+"""Health watchdog (libs/watchdog.py): detector semantics, the monotonic
+vs wall clock discipline under a chaos SkewedClock, alarm transitions and
+the served /health + /status surface.
+
+The clock tests are the load-bearing ones: a constant injected wall skew
+models a node whose clock has ALWAYS been wrong (NTP late since boot) —
+that node is healthy and must not alarm; a runtime skew step models the
+clock moving under a running node — that IS drift.  And because stall
+intervals are measured on the monotonic clock, no wall skew of any size
+may fake or mask a consensus stall.
+"""
+
+import asyncio
+import os
+import tarfile
+
+from tendermint_tpu.chaos.clock import Clock, SkewedClock
+from tendermint_tpu.libs.tracing import FlightRecorder
+from tendermint_tpu.libs.watchdog import ALARM_SEVERITY, Watchdog
+
+
+class _BlockStore:
+    def __init__(self, h=1):
+        self.h = h
+
+    def height(self):
+        return self.h
+
+
+class _RS:
+    def __init__(self):
+        self.height = 1
+        self.round = 0
+
+
+class _CS:
+    def __init__(self):
+        self.is_running = True
+        self.rs = _RS()
+        self.clock = Clock()
+
+
+class _Switch:
+    def __init__(self, n=0):
+        self.n = n
+
+    def num_peers(self):
+        return self.n
+
+
+class _Mempool:
+    def __init__(self, size=0, cap=100):
+        self._size = size
+        self.size_limit = cap
+
+    def size(self):
+        return self._size
+
+
+class _Prof:
+    lag_samples = 1
+    last_lag_ms = 0.0
+
+
+class _StubNode:
+    """The exact surface the watchdog probes, nothing else."""
+
+    def __init__(self):
+        self.consensus = _CS()
+        self.block_store = _BlockStore()
+        self.switch = None
+        self.async_verifier = None
+        self.loop_profiler = None
+        self.mempool = None
+        self.statesync_reactor = None
+        self.blockchain_reactor = None
+
+
+class TestDetectors:
+    def test_stall_fires_after_threshold_and_clears_on_commit(self):
+        node = _StubNode()
+        rec = FlightRecorder(size=128)
+        wd = Watchdog(node, stall_seconds=5.0, recorder=rec)
+        t = 1000.0
+        assert wd.check(now=t)["verdict"] == "ok"
+        assert wd.check(now=t + 4.9)["verdict"] == "ok"  # under the bound
+        h = wd.check(now=t + 5.1)
+        assert h["verdict"] == "critical"  # stall is a critical alarm
+        assert "consensus_stall" in h["alarms"]
+        assert h["alarms"]["consensus_stall"]["severity"] == "critical"
+        # tip advances -> alarm clears, verdict recovers
+        node.block_store.h = 2
+        h = wd.check(now=t + 6.0)
+        assert h["verdict"] == "ok" and h["alarms"] == {}
+        kinds = [ev["kind"] for ev in rec.events()]
+        assert "health.alarm" in kinds and "health.clear" in kinds
+        alarm_ev = next(ev for ev in rec.events() if ev["kind"] == "health.alarm")
+        assert alarm_ev["alarm"] == "consensus_stall"
+
+    def test_stall_suppressed_on_idle_wait_for_txs_node(self):
+        """A [consensus] create_empty_blocks=false node with an empty
+        mempool legitimately parks between heights: no CRITICAL alarm for
+        a healthy idle node (a load balancer acting on it would guarantee
+        it stays idle forever).  And when a tx finally arrives, the stall
+        clock starts THEN — not 10 idle minutes ago."""
+        node = _StubNode()
+        node.consensus.config = type(
+            "C", (), {"wait_for_txs": staticmethod(lambda: True)}
+        )()
+        node.mempool = _Mempool(size=0, cap=100)
+        wd = Watchdog(node, stall_seconds=5.0)
+        t = 0.0
+        wd.check(now=t)
+        assert wd.check(now=t + 600.0)["alarms"] == {}, "idle is healthy"
+        # a tx lands: detector re-arms with a FRESH baseline
+        node.mempool._size = 1
+        assert wd.check(now=t + 601.0)["alarms"] == {}
+        assert wd.check(now=t + 605.0)["alarms"] == {}  # 4s < bound
+        h = wd.check(now=t + 607.0)  # 6s of pending tx, no commit: stall
+        assert "consensus_stall" in h["alarms"]
+
+    def test_stall_suppressed_while_syncing(self):
+        node = _StubNode()
+
+        class _BR:
+            fast_sync = True
+            wait_statesync = False
+
+        node.blockchain_reactor = _BR()
+        wd = Watchdog(node, stall_seconds=1.0)
+        t = 0.0
+        wd.check(now=t)
+        # a fastsyncing node's tip "stalls" by design: no alarm
+        assert wd.check(now=t + 100.0)["verdict"] == "ok"
+
+    def test_round_churn_is_degraded_not_critical(self):
+        node = _StubNode()
+        wd = Watchdog(node, stall_seconds=1e9, round_churn=4)
+        node.consensus.rs.round = 3
+        assert wd.check(now=1.0)["verdict"] == "ok"
+        node.consensus.rs.round = 4
+        h = wd.check(now=2.0)
+        assert h["verdict"] == "degraded"
+        assert "round_churn" in h["alarms"]
+
+    def test_peer_collapse_relative_to_peak(self):
+        node = _StubNode()
+        node.switch = _Switch(0)
+        wd = Watchdog(node, stall_seconds=1e9, min_peers=2)
+        assert wd.check(now=1.0)["verdict"] == "ok"  # never had peers
+        node.switch.n = 6
+        assert wd.check(now=2.0)["verdict"] == "ok"
+        node.switch.n = 3  # exactly half: not collapse
+        assert wd.check(now=3.0)["verdict"] == "ok"
+        node.switch.n = 2  # below half the peak
+        h = wd.check(now=4.0)
+        assert "peer_collapse" in h["alarms"]
+        node.switch.n = 5
+        assert wd.check(now=5.0)["alarms"] == {}
+
+    def test_mempool_saturation(self):
+        node = _StubNode()
+        node.mempool = _Mempool(size=89, cap=100)
+        wd = Watchdog(node, stall_seconds=1e9, mempool_ratio=0.9)
+        assert wd.check(now=1.0)["alarms"] == {}
+        node.mempool._size = 90
+        assert "mempool_saturation" in wd.check(now=2.0)["alarms"]
+        node.mempool._size = 10
+        assert wd.check(now=3.0)["alarms"] == {}
+
+    def test_loop_lag_needs_two_consecutive_breaches(self):
+        node = _StubNode()
+        node.loop_profiler = _Prof()
+        wd = Watchdog(node, stall_seconds=1e9, lag_ms=100.0)
+        node.loop_profiler.last_lag_ms = 500.0
+        assert wd.check(now=1.0)["alarms"] == {}  # one breach = a burst
+        node.loop_profiler.last_lag_ms = 40.0
+        assert wd.check(now=2.0)["alarms"] == {}  # breach streak reset
+        node.loop_profiler.last_lag_ms = 500.0
+        wd.check(now=3.0)
+        h = wd.check(now=4.0)  # second consecutive breach
+        assert "loop_lag" in h["alarms"]
+
+    def test_ingress_shedding_sustained_rate(self):
+        node = _StubNode()
+
+        class _Core:
+            throttled_total = 0
+
+        class _Server:
+            core = _Core()
+
+        node.rpc_server = _Server()
+        wd = Watchdog(node, stall_seconds=1e9, shed_rate=5.0)
+        wd.check(now=0.0)  # baseline sample
+        _Core.throttled_total = 100  # 100 rejections in 1s: breach 1
+        assert wd.check(now=1.0)["alarms"] == {}  # one burst: no flap
+        _Core.throttled_total = 200  # sustained: breach 2
+        h = wd.check(now=2.0)
+        assert "ingress_shedding" in h["alarms"]
+        assert h["verdict"] == "degraded"
+        _Core.throttled_total = 201  # 1/s: under the bound -> clears
+        assert wd.check(now=3.0)["alarms"] == {}
+        # trickle below the bound never alarms
+        for i in range(4, 10):
+            _Core.throttled_total += 2
+            assert wd.check(now=float(i))["alarms"] == {}
+
+    async def test_verify_stall_from_pending_queue_age(self):
+        node = _StubNode()
+        loop = asyncio.get_event_loop()
+
+        class _AV:
+            _pending = [(b"", b"", b"", None, loop.time() - 10.0)]
+
+        node.async_verifier = _AV()
+        wd = Watchdog(node, stall_seconds=1e9, verify_stall_seconds=5.0)
+        h = wd.check(now=1.0)
+        assert "verify_stall" in h["alarms"]
+        assert h["verdict"] == "critical"
+        node.async_verifier._pending = []
+        assert wd.check(now=2.0)["verdict"] == "ok"
+
+
+class TestClockDiscipline:
+    """The satellite's pinned contract: SkewedClock must not false-trip
+    the stall/drift detectors — monotonic vs wall discipline."""
+
+    def test_constant_skew_never_trips_drift(self):
+        node = _StubNode()
+        node.consensus.clock = SkewedClock(3600.0)  # an hour wrong since boot
+        wd = Watchdog(node, stall_seconds=1e9, clock_drift_seconds=2.0)
+        for i in range(5):
+            assert wd.check(now=float(i))["alarms"] == {}, "constant skew is not drift"
+
+    def test_runtime_skew_step_trips_drift_and_unstep_clears(self):
+        node = _StubNode()
+        clock = SkewedClock(0.0)
+        node.consensus.clock = clock
+        wd = Watchdog(node, stall_seconds=1e9, clock_drift_seconds=2.0)
+        assert wd.check(now=0.0)["alarms"] == {}
+        clock.set_skew(5.0)  # the clock MOVED under a running node
+        h = wd.check(now=1.0)
+        assert "clock_drift" in h["alarms"]
+        assert h["alarms"]["clock_drift"]["severity"] == "degraded"
+        clock.set_skew(0.0)
+        assert wd.check(now=2.0)["alarms"] == {}
+
+    def test_wall_skew_cannot_fake_or_mask_a_stall(self):
+        # stall intervals are monotonic: a huge wall skew with a healthy
+        # tip must not alarm, and a real stall must alarm regardless of
+        # any skew trying to "roll back" time
+        node = _StubNode()
+        node.consensus.clock = SkewedClock(-86400.0)
+        wd = Watchdog(node, stall_seconds=5.0, clock_drift_seconds=1e18)
+        t = 0.0
+        wd.check(now=t)
+        node.block_store.h += 1
+        # advancing: healthy despite a day of wall skew
+        assert wd.check(now=t + 4.0)["alarms"] == {}
+        # stop advancing; jump the wall clock forward mid-window — the
+        # monotonic stall math must neither trip early nor late
+        node.consensus.clock.set_skew(86400.0)
+        assert "consensus_stall" not in wd.check(now=t + 8.9)["alarms"]  # 4.9s stale
+        assert "consensus_stall" in wd.check(now=t + 9.2)["alarms"]  # 5.2s stale
+
+
+class TestTransitionsAndAutodump:
+    def test_severity_table_covers_every_alarm(self):
+        assert set(ALARM_SEVERITY) == {
+            "consensus_stall", "verify_stall", "round_churn", "peer_collapse",
+            "loop_lag", "mempool_saturation", "ingress_shedding", "clock_drift",
+        }
+        assert ALARM_SEVERITY["consensus_stall"] == "critical"
+        assert ALARM_SEVERITY["verify_stall"] == "critical"
+
+    def test_autodump_fires_on_critical_transition_rate_bounded(self):
+        node = _StubNode()
+        dumps = []
+        wd = Watchdog(
+            node, stall_seconds=5.0,
+            autodump_fn=lambda health: dumps.append(health) or "x",
+            autodump_min_interval=60.0,
+        )
+        t = 0.0
+        wd.check(now=t)
+        wd.check(now=t + 6.0)  # critical: dump 1
+        assert len(dumps) == 1 and dumps[0]["verdict"] == "critical"
+        node.block_store.h += 1
+        wd.check(now=t + 7.0)  # recovers
+        wd.check(now=t + 20.0)  # stalls again -> critical, but rate-bounded
+        assert len(dumps) == 1, "flapping critical must not spam bundles"
+        node.block_store.h += 1
+        wd.check(now=t + 21.0)
+        wd.check(now=t + 90.0)  # past the rate bound: allowed again
+        assert len(dumps) == 2
+
+    def test_autodump_failure_does_not_kill_the_watchdog(self):
+        node = _StubNode()
+
+        def boom(health):
+            raise OSError("disk full")
+
+        wd = Watchdog(node, stall_seconds=5.0, autodump_fn=boom)
+        wd.check(now=0.0)
+        h = wd.check(now=6.0)  # must not raise
+        assert h["verdict"] == "critical"
+
+    def test_write_autodump_bundle_contents(self, tmp_path):
+        from tendermint_tpu.libs.watchdog import write_autodump_bundle
+
+        node = _StubNode()
+        node.flight_recorder = FlightRecorder(size=32)
+        node.flight_recorder.record("step", height=1, step="Propose")
+        path = write_autodump_bundle(node, {"verdict": "critical"}, str(tmp_path))
+        assert os.path.exists(path)
+        with tarfile.open(path) as tar:
+            names = {os.path.basename(m.name) for m in tar.getmembers()}
+        assert {"health.json", "recorder.json", "consensus.json"} <= names
+
+
+class TestLiveNode:
+    async def test_health_route_and_status_block(self, tmp_path):
+        """A real single-validator node: /health serves the verdict, and
+        /status carries the health summary block readiness gates poll."""
+        from tendermint_tpu.config import test_config as make_test_cfg
+        from tendermint_tpu.node import Node
+        from tendermint_tpu.rpc import LocalClient
+        from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
+        from tendermint_tpu.types.params import BlockParams, ConsensusParams
+
+        pv = MockPV()
+        gen = GenesisDoc(
+            chain_id="wd-chain",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[GenesisValidator(pv.address(), pv.get_pub_key(), 10)],
+            consensus_params=ConsensusParams(block=BlockParams(time_iota_ms=1)),
+        )
+        cfg = make_test_cfg(str(tmp_path / "wd"))
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = ""
+        cfg.consensus.skip_timeout_commit = False
+        cfg.consensus.timeout_commit = 0.05
+        cfg.instrumentation.watchdog_interval = 0.1
+        node = Node(cfg, gen, priv_validator=pv, db_backend="memdb")
+        await node.start()
+        try:
+            assert node.watchdog is not None and node.watchdog.is_running
+
+            async def committed(h):
+                while node.block_store.height() < h:
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(committed(2), 20.0)
+            await asyncio.sleep(0.25)  # a couple of watchdog ticks
+            c = LocalClient(node)
+            hl = await c.health()
+            assert hl["verdict"] == "ok" and hl["ok"] is True
+            assert hl["alarms"] == {} and hl["ticks"] >= 1
+            st = await c.status()
+            assert st["health"] == {"verdict": "ok", "alarms": []}
+        finally:
+            await node.stop()
