@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench bench-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke scale-smoke bls-smoke load-smoke forensics-smoke localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke scale-smoke bls-smoke bls-ext load-smoke forensics-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -39,9 +39,12 @@ chaos-smoke:     ## scripted partition/kill/twin scenario on a 4-val localnet; f
 scale-smoke:     ## 100-validator in-proc net (engine ON, relay gossip): >=10 consecutive commits + partition/heal invariants
 	$(PY) networks/local/scale_smoke.py --json
 
-bls-smoke:       ## BLS12-381 localnet: every stored commit must be ONE aggregate signature + bitmap; empty joiner fastsyncs over them
+bls-smoke:       ## BLS12-381 localnet: every stored commit must be ONE aggregate signature + bitmap (C pairing tier asserted engaged when a toolchain exists); empty joiner fastsyncs over them
 	$(PY) networks/local/bls_smoke.py --json
 	rm -rf build-bls
+
+bls-ext:         ## prebuild the BLS12-381 C pairing tier (.so) so suite/node runs don't pay the compile; fails without a working toolchain
+	$(PY) -c "from tendermint_tpu.crypto.bls import ctier; import sys; sys.exit(0 if ctier.available() else 1)"
 
 load-smoke:      ## tx-ingress firehose vs a QoS-configured 4-val localnet: explicit overload errors, zero checker violations, commit rate recovers
 	$(PY) networks/local/load_smoke.py --json

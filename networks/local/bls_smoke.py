@@ -35,6 +35,9 @@ import sys
 import time
 import urllib.request
 
+# bls_tier gauge values (libs/metrics.VerifyMetrics): 1=C extension, 2=pure
+BLS_TIER_C = 1
+
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, REPO)
 
@@ -69,6 +72,39 @@ def spawn(home: str, env) -> subprocess.Popen:
 def rpc_port_of(home: str) -> int:
     cfg = load_config(os.path.join(home, "config", "config.toml"), home=home)
     return int(cfg.rpc.laddr.rsplit(":", 1)[1])
+
+
+def enable_prometheus(home: str, port: int) -> None:
+    """Turn the node's metrics endpoint on so the rig can assert WHICH
+    BLS tier carried the net — same node-telemetry pattern as the verify
+    engine's backend_tier gauge."""
+    path = os.path.join(home, "config", "config.toml")
+    cfg = load_config(path, home=home)
+    cfg.instrumentation.prometheus = True
+    cfg.instrumentation.prometheus_listen_addr = f"127.0.0.1:{port}"
+    save_config(cfg, path)
+
+
+def scrape_bls_tier(port: int):
+    """The node's tendermint_verify_bls_tier gauge value, or None."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=3
+        ) as r:
+            body = r.read().decode("utf-8", "replace")
+    except Exception:
+        return None
+    for line in body.splitlines():
+        if line.startswith("tendermint_verify_bls_tier{"):
+            try:
+                return int(float(line.rsplit(" ", 1)[1]))
+            except ValueError:
+                return None
+    return None
+
+
+def have_toolchain() -> bool:
+    return shutil.which("cc") is not None
 
 
 def check_commit(commit: dict, n_vals: int) -> int:
@@ -130,6 +166,9 @@ def main() -> int:
 
     homes = [os.path.join(build, f"node{i}") for i in range(n)]
     ports = [rpc_port_of(h) for h in homes]
+    metric_ports = [args.base_port + 900 + i for i in range(n)]
+    for home, mport in zip(homes, metric_ports):
+        enable_prometheus(home, mport)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     procs = [spawn(h, env) for h in homes]
@@ -155,6 +194,22 @@ def main() -> int:
         elapsed = time.time() - t0
         hs = heights(ports)
         print(f"BLS net at heights {hs} after {elapsed:.1f}s")
+
+        # ---- tier assertion: the fast tier must have carried the net ----
+        # Every node exports tendermint_verify_bls_tier (1=C, 2=pure).  A
+        # host with a working toolchain running the ~460 ms pure pairing
+        # is exactly the silent regression this gate exists to catch; a
+        # toolchain-less host passes on the pure tier by design.
+        tiers = [scrape_bls_tier(mp) for mp in metric_ports]
+        print(f"bls tier per node (1=C, 2=pure): {tiers}")
+        if any(t is None for t in tiers):
+            print("could not scrape tendermint_verify_bls_tier from every "
+                  f"node: {tiers}", file=sys.stderr)
+            return 1
+        if have_toolchain() and any(t != BLS_TIER_C for t in tiers):
+            print(f"toolchain present but the C pairing tier did not engage "
+                  f"(tiers {tiers})", file=sys.stderr)
+            return 1
 
         # ---- phase 2: every canonical commit must be aggregate ----------
         sizes = []
@@ -220,6 +275,7 @@ def main() -> int:
         result = {
             "bls_commit_bytes": size,
             "bls_commits_checked": checked,
+            "bls_tier": "c" if tiers[0] == BLS_TIER_C else "pure",
             "commits_per_sec": round(min(hs) / elapsed, 3),
             "heights": hs,
             "validators": n,

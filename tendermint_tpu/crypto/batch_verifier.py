@@ -1023,7 +1023,9 @@ class AsyncBatchVerifier(Service):
 
         loop = asyncio.get_event_loop()
         t0 = loop.time()
-        self.verifier.recorder.record("verify.bls_agg", n=len(items))
+        self.verifier.recorder.record(
+            "verify.bls_agg", n=len(items), tier=_bls_scheme.active_tier()
+        )
         if self._executor is not None:
             res = await loop.run_in_executor(
                 self._executor, _bls_scheme.batch_verify_aggregates, list(items)

@@ -6,11 +6,19 @@ a +2/3 commit of N precommits folds into ONE 96-byte aggregate signature +
 signer bitmap, verified with a single pairing-product check instead of N
 per-signature verifies.
 
-Two tiers, mirroring the ed25519 stack:
+Three tiers, mirroring the ed25519 stack:
 
+* C fast tier (`ctier` loading csrc/bls12_381.c): Montgomery-limb field
+  tower, multi-pairing Miller loop with one shared final exponentiation,
+  subgroup-checked decompress and the aggregate/apk fold scalar work —
+  compiled on demand (hostprep discipline), GIL-dropping, ~3 ms per
+  aggregate check vs ~460 ms pure.  The default whenever a toolchain
+  exists; `scheme.active_tier()` / `tendermint_verify_bls_tier` report it.
 * reference tier (`fields`/`curve`/`pairing`/`hash_to_curve`/`scheme`):
-  pure-Python field towers and pairings — the differential oracle and the
-  dependency-less host path.
+  pure-Python field towers and pairings — the differential oracle the C
+  tier is verdict- and bit-pinned against, and the dependency-less
+  no-toolchain path.  Hash-to-curve always runs here (memoized off the
+  hot path).
 * JAX tier (`jax_tier`): batched Montgomery limb arithmetic for the hot
   multi-point G1/G2 aggregation (the per-commit Σpk / Σsig sums), riding
   the same vmap-over-batch design as the ed25519 limb kernels.

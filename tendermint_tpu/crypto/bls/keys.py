@@ -51,6 +51,11 @@ class BlsPubKey(PubKey):
     def verify(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != self.SIG_SIZE:
             return False
+        if scheme.active_tier() == "c":
+            # the C tier keeps its own bounded decompress memo — forcing
+            # the pure-Python decompress here would cost more than the
+            # whole C pairing
+            return scheme.verify(self._data, msg, sig)
         pt = self.point()
         if pt is None:
             return False

@@ -136,7 +136,20 @@ def final_exponentiation(f):
 
 def pairing_product(pairs: Sequence[tuple]) -> tuple:
     """Π e(Pᵢ, Qᵢ) for Jacobian (G1 point, G2 point) pairs — one shared
-    final exponentiation.  Identity operands contribute the neutral 1."""
+    final exponentiation.  Identity operands contribute the neutral 1.
+
+    Routed through the compiled tier (csrc/bls12_381.c via ctier) when a
+    toolchain built it — same HHT decomposition, so the output is
+    bit-identical and this pure loop stays the differential reference."""
+    ct = _ctier()
+    if ct is not None:
+        return ct.pairing_product_points(pairs)
+    return pairing_product_pure(pairs)
+
+
+def pairing_product_pure(pairs: Sequence[tuple]) -> tuple:
+    """The pure-Python reference product (the differential oracle the C
+    tier is pinned against; also the no-toolchain fallback)."""
     f = F12_ONE
     for g1p, g2p in pairs:
         p_aff = curve.g1_affine(g1p)
@@ -154,4 +167,14 @@ def pairing(g1p, g2p) -> tuple:
 
 def pairing_check(pairs: Sequence[tuple]) -> bool:
     """True iff Π e(Pᵢ, Qᵢ) == 1 — THE verification equation."""
-    return f12_eq(pairing_product(pairs), F12_ONE)
+    ct = _ctier()
+    if ct is not None:
+        return ct.pairing_check_points(pairs)
+    return f12_eq(pairing_product_pure(pairs), F12_ONE)
+
+
+def _ctier():
+    """The compiled fast tier, or None (no toolchain / forced pure)."""
+    from . import ctier
+
+    return ctier.get()

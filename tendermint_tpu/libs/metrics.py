@@ -295,7 +295,7 @@ class VerifyMetrics:
                 "batch_size", "queue_wait_seconds", "host_prep_seconds",
                 "device_seconds", "flush_quantum_seconds", "bucket_compiles",
                 "table_cache_hits", "table_cache_misses", "backend_tier",
-                "bls_agg_seconds", "bls_agg_checks",
+                "bls_agg_seconds", "bls_agg_checks", "bls_tier",
             ):
                 setattr(self, name, _NOP)
             return
@@ -354,6 +354,11 @@ class VerifyMetrics:
         )
         self.bls_agg_checks = c(
             "bls_agg_checks", "Aggregate-commit claims verified (pairing or memo)."
+        )
+        self.bls_tier = g(
+            "bls_tier",
+            "Active BLS pairing tier: 1=C extension (csrc/bls12_381.c), "
+            "2=pure python reference (~460 ms/check).",
         )
 
 

@@ -153,6 +153,32 @@ class Node(Service):
         from .crypto import backend as _crypto_backend
 
         self.metrics_provider.verify.backend_tier.set(_crypto_backend.active_tier())
+        # BLS pairing tier, same operator story as backend_tier: a BLS net
+        # silently on the ~460 ms pure pairing is a fleet-visible gauge,
+        # not a mystery slowdown.  Only probed when this chain actually
+        # carries BLS validators — an ed25519-only node must neither
+        # compile csrc/bls12_381.c nor warn about a missing toolchain for
+        # a subsystem it never uses.  Probed on an executor thread anyway:
+        # BLS chains have normally paid the compile during the genesis PoP
+        # batch check, but a cold cache must not stall the event loop.
+        from .crypto.bls.keys import BlsPubKey as _BlsPubKey
+
+        if any(
+            isinstance(v.pub_key, _BlsPubKey) for v in self.genesis_doc.validators
+        ):
+            from .crypto.bls import scheme as _bls_scheme
+
+            def _probe_bls_tier() -> int:
+                return 1 if _bls_scheme.active_tier() == "c" else 2
+
+            _bls_gauge = self.metrics_provider.verify.bls_tier
+            asyncio.get_event_loop().run_in_executor(
+                None, _probe_bls_tier
+            ).add_done_callback(
+                lambda fut: _bls_gauge.set(fut.result())
+                if fut.exception() is None
+                else None
+            )
         # crash-persistent flight spool ([instrumentation] flight_spool):
         # recorder events journal to disk on a cadence OFF the recording
         # hot path, so a SIGKILL leaves the last seconds of spans for
