@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench bench-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke scale-smoke bls-smoke bls-ext load-smoke forensics-smoke localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke disk-smoke scale-smoke bls-smoke bls-ext load-smoke forensics-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -35,6 +35,10 @@ statesync-smoke: ## empty 4th node joins a 3-val localnet via snapshot restore (
 chaos-smoke:     ## scripted partition/kill/twin scenario on a 4-val localnet; fails on any invariant violation
 	$(PY) networks/local/chaos_smoke.py --json
 	rm -rf build-chaos
+
+disk-smoke:      ## storage-fault chaos: seeded block-store bit-rot must be scan-detected, quarantined + refilled from peers; ENOSPC must halt cleanly (read path + alarm up) and recover after heal
+	$(PY) networks/local/disk_smoke.py --json
+	rm -rf build-disk
 
 scale-smoke:     ## 100-validator in-proc net (engine ON, relay gossip): >=10 consecutive commits + partition/heal invariants
 	$(PY) networks/local/scale_smoke.py --json

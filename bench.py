@@ -384,6 +384,32 @@ def bench_chaos_recovery():
         return json.loads(run.stdout.strip().splitlines()[-1])
 
 
+def bench_disk():
+    """Storage-fault chaos acceptance as numbers: run the rot/ENOSPC
+    scenario (networks/local/disk_smoke.py) and report
+    `disk_fault_recovery_ms` (seeded block-store bit-rot -> integrity-scan
+    detection -> quarantine -> verified peer refill -> served again),
+    `store_integrity_scan_ms` (the sweep itself) and `enospc_recovery_ms`
+    (clean halt under ENOSPC -> heal + restart -> commits past the
+    pre-fault tip), while the invariant checker also proves agreement and
+    that no node ever served corrupted bytes as a valid block.  Raises if
+    any invariant failed."""
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as tmp:
+        run = subprocess.run(
+            [sys.executable, os.path.join(repo, "networks", "local", "disk_smoke.py"),
+             "--build-dir", os.path.join(tmp, "build"), "--base-port", "31756", "--json"],
+            capture_output=True, text=True, timeout=420, cwd=repo,
+        )
+        if run.returncode != 0:
+            raise RuntimeError(f"disk smoke failed:\n{run.stdout}\n{run.stderr}")
+        return json.loads(run.stdout.strip().splitlines()[-1])
+
+
 def bench_scale_100val():
     """BASELINE config #2 measured LIVE for the first time: a 100-validator
     in-process net (verify engine ON, chordal peer topology, relay gossip +
@@ -846,6 +872,10 @@ def main() -> None:
     except Exception as e:
         chaos = {"chaos_partition_recovery_ms": -1.0, "error": str(e)[:300]}
     try:
+        disk = bench_disk()
+    except Exception as e:
+        disk = {"disk_fault_recovery_ms": -1.0, "error": str(e)[:300]}
+    try:
         scale = bench_scale_100val()
     except Exception as e:
         scale = {"e2e_commits_per_sec_100val": -1.0, "error": str(e)[:300]}
@@ -902,6 +932,10 @@ def main() -> None:
         "chaos_partition_recovery_ms": chaos.get("chaos_partition_recovery_ms", -1.0),
         "chaos_restart_recovery_ms": chaos.get("restart_recovery_ms"),
         "chaos_evidence_height": chaos.get("evidence_height"),
+        "disk_fault_recovery_ms": disk.get("disk_fault_recovery_ms", -1.0),
+        "store_integrity_scan_ms": disk.get("store_integrity_scan_ms", -1.0),
+        "enospc_recovery_ms": disk.get("enospc_recovery_ms"),
+        "disk_scan_checked": disk.get("scan_checked"),
         "crash_bundle_completeness": forensics.get("crash_bundle_completeness", -1.0),
         "health_detect_latency_ms": forensics.get("health_detect_latency_ms", -1.0),
         "health_clear_ms": forensics.get("health_clear_ms"),

@@ -22,7 +22,11 @@ Pieces:
   scenario.py  declarative seeded fault timelines + the async runner and
                the in-process rig
   checker.py   Jepsen-flavor invariant checker: agreement, no height
-               regression, bounded recovery, accountability
+               regression, bounded recovery, accountability, no serving
+               of corrupted blocks
+  disk.py      the disk as a fault domain: per-store seeded ENOSPC / EIO /
+               torn appends / lying fsyncs / read bit-rot (FaultyDB,
+               FaultyGroup, DiskFaultTable) + persistent block-store rot
 
 Faults are injected only when `[chaos] enabled` is on (config) or a test
 holds direct handles; the unsafe RPC control routes additionally require
@@ -31,13 +35,25 @@ holds direct handles; the unsafe RPC control routes additionally require
 
 from .checker import InvariantChecker, RecoveryTimer
 from .clock import Clock, SkewedClock, SYSTEM_CLOCK
+from .disk import (
+    DiskFaultTable,
+    DiskPolicy,
+    FaultyDB,
+    FaultyGroup,
+    policy_for,
+    rot_block_store,
+)
 from .link import LinkPolicy, LinkPolicyTable
 from .scenario import FaultEvent, InProcRig, Scenario, ScenarioRunner
 from .twin import TwinSigner, install_twin
 
 __all__ = [
     "Clock",
+    "DiskFaultTable",
+    "DiskPolicy",
     "FaultEvent",
+    "FaultyDB",
+    "FaultyGroup",
     "InProcRig",
     "InvariantChecker",
     "LinkPolicy",
@@ -49,4 +65,6 @@ __all__ = [
     "SYSTEM_CLOCK",
     "TwinSigner",
     "install_twin",
+    "policy_for",
+    "rot_block_store",
 ]

@@ -84,6 +84,26 @@ class InvariantChecker:
             )
         seen[node] = block_hash
 
+    def observe_served_block(
+        self, node: int, height: int, claimed_hash: bytes, block_hash: bytes
+    ) -> None:
+        """A FULL block a node served (via `/block`, fastsync, or a store
+        read) next to the identity it claims for it (its meta / commit
+        hash at that height).  Serving content whose recomputed hash does
+        not match the claim means the node handed out CORRUPTED data as a
+        valid block — a violation, not a crash (the self-healing store's
+        whole promise is answering "don't have it" instead).  The claimed
+        hash also joins the regular agreement check."""
+        if not claimed_hash or not block_hash:
+            return
+        if block_hash != claimed_hash:
+            self._violate(
+                f"node {node} SERVED a corrupted block at height {height}: "
+                f"content {block_hash.hex()[:16]} != claimed {claimed_hash.hex()[:16]}"
+            )
+            return
+        self.observe_block_hash(node, height, claimed_hash)
+
     def note_restart(self, node: int) -> None:
         """Re-arm the regression floor for a node whose rig legitimately
         wipes state on restart (memdb backends); its history observations

@@ -14,6 +14,9 @@ with seed 7, byte-identical fault schedule.
     restart 2 @14
     link 0->3 drop=0.3 delay=0.02 @16
     skew 1 0.75 @18
+    disk 2 enospc @20~0.5
+    disk 2 heal @26
+    rot 1 blockstore h=3 @22
 
 Grammar: clauses separated by `;` or newlines, `#` comments.  `@T`
 anchors the clause at T seconds from scenario start; `@T~J` jitters it
@@ -34,6 +37,13 @@ Actions:
                                 jitter= rate=)
     skew N S                    set node N's consensus wall-clock skew to
                                 S seconds
+    disk N KIND [store=S] [p=P] disk fault on node N: KIND in enospc|eio|
+                                eio_fsync|torn|fsync_lie|bitrot (store
+                                default "*" = every store, p default 1.0),
+                                or KIND=heal to clear (optionally one store)
+    rot N STORE h=H [part=I]    persistent seeded bit-rot: flip one byte in
+                                node N's stored block part (height H); the
+                                integrity scan must detect + quarantine it
 
 The executor (`ScenarioRunner`) drives any object satisfying the Rig
 surface; `InProcRig` adapts a list of in-process Nodes (the tier-1 path),
@@ -140,6 +150,49 @@ class Scenario:
                     events.append(
                         FaultEvent(t, "skew", {"node": int(args[0]), "skew_s": float(args[1])}, clause)
                     )
+                elif action == "disk":
+                    from .disk import FAULT_KINDS, STORES
+
+                    node, kind = int(args[0]), args[1]
+                    kv = {"store": "*", "p": 1.0}
+                    for a in args[2:]:
+                        k, v = a.split("=", 1)
+                        if k == "store":
+                            kv["store"] = v
+                        elif k == "p":
+                            kv["p"] = float(v)
+                        else:
+                            raise ScenarioError(f"unknown disk key {k!r} in {clause!r}")
+                    if kind != "heal" and kind not in FAULT_KINDS:
+                        raise ScenarioError(
+                            f"unknown disk fault {kind!r} in {clause!r} "
+                            f"(want one of {FAULT_KINDS} or heal)"
+                        )
+                    if kv["store"] != "*" and kv["store"] not in STORES:
+                        raise ScenarioError(f"unknown store {kv['store']!r} in {clause!r}")
+                    events.append(
+                        FaultEvent(t, "disk", {"node": node, "kind": kind, **kv}, clause)
+                    )
+                elif action == "rot":
+                    node, store = int(args[0]), args[1]
+                    if store != "blockstore":
+                        raise ScenarioError(
+                            f"rot supports store 'blockstore' only (got {store!r} in {clause!r})"
+                        )
+                    kv = {"height": None, "part": 0}
+                    for a in args[2:]:
+                        k, v = a.split("=", 1)
+                        if k == "h":
+                            kv["height"] = int(v)
+                        elif k == "part":
+                            kv["part"] = int(v)
+                        else:
+                            raise ScenarioError(f"unknown rot key {k!r} in {clause!r}")
+                    if kv["height"] is None:
+                        raise ScenarioError(f"rot needs h=HEIGHT in {clause!r}")
+                    events.append(
+                        FaultEvent(t, "rot", {"node": node, "store": store, **kv}, clause)
+                    )
                 else:
                     raise ScenarioError(f"unknown action {action!r} in {clause!r}")
             except (IndexError, ValueError) as e:
@@ -176,6 +229,8 @@ class ScenarioRunner:
         async heal()
         async kill(i) / restart(i)
         async set_skew(i, skew_s)
+        async set_disk(i, store, kind, p) / heal_disk(i, store)
+        async rot(i, store, height, part)
     """
 
     def __init__(self, scenario: Scenario, rig, recorder=None):
@@ -226,6 +281,17 @@ class ScenarioRunner:
             await self.rig.set_link(ev.args["src"], ev.args["dst"], pol)
         elif a == "skew":
             await self.rig.set_skew(ev.args["node"], ev.args["skew_s"])
+        elif a == "disk":
+            if ev.args["kind"] == "heal":
+                await self.rig.heal_disk(ev.args["node"], ev.args["store"])
+            else:
+                await self.rig.set_disk(
+                    ev.args["node"], ev.args["store"], ev.args["kind"], ev.args["p"]
+                )
+        elif a == "rot":
+            await self.rig.rot(
+                ev.args["node"], ev.args["store"], ev.args["height"], ev.args["part"]
+            )
         else:  # parse() already rejects unknown actions
             raise ScenarioError(f"unexecutable action {a!r}")
 
@@ -285,3 +351,31 @@ class InProcRig:
             cs.clock.set_skew(skew_s)
         else:
             cs.clock = SkewedClock(skew_s)
+
+    # -- disk faults ---------------------------------------------------------
+
+    def _disk_table(self, i: int):
+        table = getattr(self.nodes[i], "disk_faults", None)
+        if table is None:
+            raise RuntimeError(
+                f"node {i} has no DiskFaultTable — build it with [chaos] enabled"
+            )
+        return table
+
+    async def set_disk(self, i: int, store: str, kind: str, p: float = 1.0) -> None:
+        from .disk import policy_for
+
+        self._disk_table(i).set_policy(store, policy_for(kind, p))
+
+    async def heal_disk(self, i: int, store: str = "*") -> None:
+        self._disk_table(i).heal(None if store == "*" else store)
+
+    async def rot(self, i: int, store: str, height: int, part: int = 0) -> None:
+        from .disk import rot_block_store
+
+        if store != "blockstore":
+            raise RuntimeError(f"rot supports 'blockstore' only, got {store!r}")
+        info = rot_block_store(
+            self.nodes[i].block_store, height, seed=self._disk_table(i).seed, part_index=part
+        )
+        self.log.info("rot injected", node=i, height=height, **info)

@@ -514,6 +514,7 @@ async def _debug_rpc_sections(rpc_laddr: str) -> dict:
             ("consensus_state", "dump_consensus_state", {}),
             ("recorder", "dump_flight_recorder", {}),
             ("health", "health", {}),
+            ("storage", "storage_info", {}),
             ("tasks", "unsafe_dump_tasks", {}),
         ):
             try:
@@ -567,6 +568,55 @@ def _sanitized_config_text(path: str) -> "str | None":
     return "".join(out)
 
 
+def _offline_storage_section(cfg) -> dict:
+    """The storage section of a bundle built from the HOME DIR ALONE — a
+    disk-sick node is exactly the node most likely to be dead by the time
+    the bundle is taken.  Per-store disk usage, WAL/spool chunk counts,
+    free space, and a bounded read-only integrity scan of the block store
+    so an offline bundle SHOWS the rot that killed the node.  Shares the
+    walk helpers with the live `storage_info` route so both modes stay
+    field-compatible."""
+    from .libs.autofile import dir_usage, group_disk_stats
+
+    out: dict = {"mode": "offline"}
+    db_dir = cfg.db_dir()
+    out["disk_usage"] = dir_usage(db_dir)
+    try:
+        st = os.statvfs(db_dir)
+        out["free_bytes"] = st.f_bavail * st.f_frsize
+    except OSError:
+        out["free_bytes"] = None
+    wals = {}
+    for label, head in (
+        ("consensus_wal", cfg.wal_file()),
+        ("mempool_wal", os.path.join(cfg.mempool_wal_dir(), "wal") if cfg.mempool.wal_dir else ""),
+        ("flight_spool", cfg.flight_spool_file()),
+    ):
+        stats = group_disk_stats(head) if head else None
+        if stats is not None:
+            wals[label] = stats
+    out["wals"] = wals
+    # read-only integrity sweep of the dead node's block store (sqlite
+    # only; bounded — a forensics bundle is not the place for an archive
+    # scan).  Every failure degrades to an error note, never sinks the
+    # bundle.
+    bs_path = os.path.join(db_dir, "blockstore.db")
+    if os.path.exists(bs_path):
+        try:
+            from .libs.kvstore import SQLiteDB
+            from .store import BlockStore
+
+            db = SQLiteDB(bs_path)
+            try:
+                store = BlockStore(db)
+                out["integrity_scan"] = store.integrity_scan(limit=64)
+            finally:
+                db.close()
+        except Exception as e:  # noqa: BLE001 — per-section degradation
+            out["integrity_scan"] = {"error": repr(e)}
+    return out
+
+
 def _build_debug_bundle(home: str, rpc_laddr: str, offline: bool) -> dict:
     """Assemble every section of a forensics bundle as {filename: bytes}.
 
@@ -616,6 +666,18 @@ def _build_debug_bundle(home: str, rpc_laddr: str, offline: bool) -> dict:
         mwal = _tail_file(os.path.join(cfg.mempool_wal_dir(), "wal"))
         if mwal is not None:
             files["mempool_wal.tail"] = mwal
+
+    # storage section: the live storage_info route when it answered, else
+    # rebuilt offline from the home dir (incl. a bounded integrity scan —
+    # a bundle from a disk-sick node must show WHY it died)
+    live_storage = rpc_sections.get("storage")
+    if not isinstance(live_storage, dict) or "error" in live_storage:
+        try:
+            files["storage.json"] = json.dumps(
+                _offline_storage_section(cfg), indent=1, default=repr
+            ).encode()
+        except Exception as e:  # noqa: BLE001 — per-section degradation
+            files["storage.json"] = json.dumps({"error": repr(e)}).encode()
 
     # the crash spool: raw tail for byte-level forensics plus the torn-
     # tail-tolerant replay as a dump-shaped JSON trace-net can merge

@@ -301,6 +301,28 @@ class ChaosConfig:
 
 
 @dataclass
+class StorageConfig:
+    """Store integrity + disk-fault degradation (store/block_store.py seal
+    + quarantine + libs/watchdog.py StorageHealth; no reference
+    counterpart — the reference trusts goleveldb's internal CRCs and has
+    no recovery story past them).
+
+    The boot scan verifies block-store content against identity (per-entry
+    crc seals + reassembled block hash vs meta) and QUARANTINES corrupt
+    heights, which the fastsync refill machinery then re-fetches from
+    peers — self-healing instead of serving rot or wedging.
+    `integrity_scan_limit` bounds the boot sweep to the most recent N
+    heights (0 = full scan; a deep archive node pays the full sweep only
+    when asked via the unsafe_store_integrity_scan route)."""
+
+    integrity_scan_on_boot: bool = True
+    integrity_scan_limit: int = 512
+    # disk_pressure watchdog alarm threshold: free bytes on the data dir's
+    # filesystem below which the node self-reports BEFORE the first ENOSPC
+    min_free_bytes: int = 128 * 1024 * 1024
+
+
+@dataclass
 class TxIndexConfig:
     indexer: str = "kv"  # kv | null
 
@@ -369,6 +391,9 @@ class InstrumentationConfig:
     watchdog_min_peers: int = 2
     watchdog_autodump: bool = True
     watchdog_autodump_min_interval: float = 60.0
+    # disk_fault alarm: held this long past the last storage fault (a
+    # component HALTED on persistence stays critical until restart)
+    watchdog_disk_fault_hold: float = 30.0
 
 
 @dataclass
@@ -383,6 +408,7 @@ class Config:
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
 
@@ -521,6 +547,12 @@ class Config:
             raise ValueError("chaos.twin requires chaos.enabled")
         if self.chaos.clock_skew != 0.0 and not self.chaos.enabled:
             raise ValueError("chaos.clock_skew requires chaos.enabled")
+        if self.storage.integrity_scan_limit < 0:
+            raise ValueError("storage.integrity_scan_limit can't be negative")
+        if self.storage.min_free_bytes < 0:
+            raise ValueError("storage.min_free_bytes can't be negative")
+        if inst.watchdog_disk_fault_hold < 0:
+            raise ValueError("instrumentation.watchdog_disk_fault_hold can't be negative")
 
 
 def default_config(home: str = "~/.tendermint_tpu") -> Config:
@@ -571,6 +603,7 @@ def save_config(cfg: Config, path: str) -> None:
         "consensus": cfg.consensus,
         "tpu": cfg.tpu,
         "chaos": cfg.chaos,
+        "storage": cfg.storage,
         "tx_index": cfg.tx_index,
         "instrumentation": cfg.instrumentation,
     }
@@ -619,6 +652,7 @@ def load_config(path: str, home: Optional[str] = None) -> Config:
     apply(cfg.consensus, data.get("consensus", {}))
     apply(cfg.tpu, data.get("tpu", {}))
     apply(cfg.chaos, data.get("chaos", {}))
+    apply(cfg.storage, data.get("storage", {}))
     apply(cfg.tx_index, data.get("tx_index", {}))
     apply(cfg.instrumentation, data.get("instrumentation", {}))
     return cfg

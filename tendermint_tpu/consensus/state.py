@@ -22,6 +22,7 @@ attributes precisely so byzantine tests can hijack them
 from __future__ import annotations
 
 import asyncio
+import errno
 import time
 from typing import Optional, Tuple
 
@@ -58,6 +59,25 @@ class InvalidProposalSignatureError(Exception):
 
 class InvalidProposalPOLRoundError(Exception):
     pass
+
+
+#: OSError errnos that genuinely mean "the disk refused" — the storage-halt
+#: and refuse-the-sign paths trigger ONLY on these; every other OSError
+#: (connection resets from a socket ABCI app or remote signer, interrupted
+#: syscalls, ...) keeps its original handling
+_STORAGE_ERRNOS = frozenset(
+    getattr(errno, name)
+    for name in ("ENOSPC", "EDQUOT", "EIO", "EROFS", "ENODEV", "ENXIO", "EFBIG")
+    if hasattr(errno, name)
+)
+
+
+def _is_storage_fault(e: BaseException) -> bool:
+    return (
+        isinstance(e, OSError)
+        and not isinstance(e, ConnectionError)
+        and e.errno in _STORAGE_ERRNOS
+    )
 
 
 def _vote_to_wire(vote: Vote) -> dict:
@@ -98,6 +118,13 @@ class ConsensusState(Service):
         self.priv_validator = None
         self.wal = NilWAL()
         self.do_wal_catchup = True
+        #: set when the receive routine halted CLEANLY on a storage fault
+        #: (ENOSPC/EIO from the WAL, block store or state store) — the
+        #: node's read path stays up, only consensus participation stops
+        self.halted_reason: Optional[str] = None
+        #: node wires a libs.watchdog.StorageHealth so persistence faults
+        #: reach the disk_fault watchdog alarm + forensics pipeline
+        self.storage_health = None
         # set only while finalizing from a peer-shipped AggregateCommit;
         # update_to_state consumes it as the next height's last-commit
         self._pending_agg_last_commit = None
@@ -331,13 +358,48 @@ class ConsensusState(Service):
         except asyncio.CancelledError:
             raise
         except Exception as e:  # chain halt on consensus failure (state.go:617)
-            import traceback
+            if _is_storage_fault(e):
+                # storage fault (ENOSPC / EIO from the WAL, block store or
+                # state store): a node that cannot PERSIST must not keep
+                # signing — but this is a CLEAN, attributed halt, not an
+                # undefined-state CONSENSUS FAILURE.  Nothing was signed
+                # past the failed write (the WAL append precedes
+                # processing, the privval save precedes signature
+                # release), the RPC read path stays up, and the watchdog's
+                # disk_fault alarm + forensics pipeline get the event.
+                # ONLY storage errnos qualify — a ConnectionResetError
+                # from a socket ABCI app is an OSError too, and routing it
+                # here would hand the operator disk forensics for an
+                # app-layer failure.
+                self._storage_halt(e)
+            else:
+                import traceback
 
-            self.log.error("CONSENSUS FAILURE!!!", err=repr(e))
-            traceback.print_exc()
+                self.log.error("CONSENSUS FAILURE!!!", err=repr(e))
+                traceback.print_exc()
         finally:
-            self.wal.close()
+            try:
+                self.wal.close()
+            except OSError:
+                pass  # a dying disk may refuse even the close flush
             self._done.set()
+
+    def _storage_halt(self, err: OSError) -> None:
+        kind = errno.errorcode.get(err.errno, "OSError") if err.errno else "OSError"
+        self.halted_reason = f"storage fault ({kind}): {err}"
+        self.log.error(
+            "consensus halted on storage fault (clean)",
+            err=repr(err),
+            height=self.rs.height,
+            round=self.rs.round,
+        )
+        self.recorder.record(
+            "consensus.storage_halt", fault=kind, height=self.rs.height
+        )
+        sh = self.storage_health
+        if sh is not None:
+            sh.note_write_error("consensus", err)
+            sh.note_halt("consensus", self.halted_reason)
 
     async def _handle_msg(self, mi: dict) -> None:
         """state.go:678."""
@@ -1225,7 +1287,22 @@ class ConsensusState(Service):
         try:
             vote = await self._sign_vote(msg_type, hash_, header)
         except Exception as e:
-            if not self.replay_mode:
+            if _is_storage_fault(e):
+                # the sign path REFUSED: either the pre-sign WAL fsync or
+                # the privval's last-sign-state save failed (ENOSPC/EIO).
+                # No signature escaped — persist-before-release means not
+                # voting is the SAFE degradation.  Record it so the
+                # watchdog's disk_fault alarm fires, but keep consensus
+                # alive (the disk may heal; peers' votes still advance
+                # us).  A remote-signer connection error stays on the
+                # generic path below — that is not disk forensics.
+                self.log.error(
+                    "vote refused: sign-path persistence failure", err=repr(e)
+                )
+                sh = self.storage_health
+                if sh is not None:
+                    sh.note_write_error("sign", e)
+            elif not self.replay_mode:
                 self.log.error("error signing vote", err=str(e))
             return None
         self._send_internal_nowait({"type": "vote", "vote": vote, "peer_id": ""})

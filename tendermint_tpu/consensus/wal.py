@@ -4,11 +4,19 @@ Reference parity: consensus/wal.go (WAL iface:64, BaseWAL:82, Write:184,
 WriteSync:201, SearchForEndHeight:231, WALEncoder.Encode:302 crc32+length
 framing, WALDecoder:347, nilWAL:404).
 
-Record framing: crc32(payload) u32 BE | length u32 BE | msgpack payload.
-Payload = {"type": "msg"|"timeout"|"roundstate"|"endheight",
-           "time_ns": int, ...}.  Every consensus input is logged before
-processing; own messages fsync (WriteSync) so a crash can never produce a
-double-sign after replay.
+Record framing: crc32(payload) u32 BE | length u32 BE | msgpack payload
+(the shared libs/autofile frame).  Payload = {"type": "msg"|"timeout"|
+"roundstate"|"endheight", "time_ns": int, ...}.  Every consensus input is
+logged before processing; own messages fsync (WriteSync) so a crash can
+never produce a double-sign after replay.
+
+Corruption discipline: a torn TAIL record (crash mid-write) is truncated
+on reopen; MID-FILE corruption (silent bit-rot) is detected by the crc —
+`all_records()` stays loud (raises WALCorruptionError, the strict
+contract fuzz tests pin), while the REPLAY paths (`replay_records`,
+`search_for_end_height`) resync past the corrupt region, count what was
+skipped, and keep every record the disk still faithfully holds, instead
+of either crashing catchup or replaying garbage.
 """
 
 from __future__ import annotations
@@ -19,10 +27,18 @@ import zlib
 from typing import Iterator, List, Optional, Tuple
 
 from ..encoding import codec
+from ..libs import autofile
 from ..libs.autofile import Group
 
 _HEADER = struct.Struct(">II")
 MAX_RECORD_BYTES = 10 * 1024 * 1024  # > max block part msg
+
+# re-exported terminal kinds (one framing walker lives in libs/autofile —
+# two copies of the subtle header/crc/advance logic would drift)
+TORN = autofile.TORN  # incomplete header/payload at EOF (crash mid-write)
+CORRUPT = autofile.CORRUPT  # bad crc / absurd length (NOT safely truncatable)
+CLEAN = autofile.CLEAN  # ends on a record boundary
+SKIPPED = autofile.SKIPPED  # resync mode: corrupt region jumped over
 
 
 class WALCorruptionError(Exception):
@@ -34,36 +50,12 @@ def encode_record(payload: dict) -> bytes:
     return _HEADER.pack(zlib.crc32(data) & 0xFFFFFFFF, len(data)) + data
 
 
-# one framing walker serves both replay decode and crash repair — two
-# copies of the subtle header/crc/advance logic would drift
-TORN = "torn"  # incomplete header/payload at EOF (crash mid-write)
-CORRUPT = "corrupt"  # bad crc / absurd length (NOT safely truncatable)
-CLEAN = "clean"  # ends on a record boundary
-
-
-def walk_records(raw: bytes) -> Iterator[tuple]:
+def walk_records(raw: bytes, resync: bool = False) -> Iterator[tuple]:
     """Yield ('record', offset, payload_bytes) for each whole record, then
-    exactly one terminal (TORN|CORRUPT|CLEAN, offset, detail)."""
-    pos = 0
-    n = len(raw)
-    while pos < n:
-        if n - pos < _HEADER.size:
-            yield (TORN, pos, "torn header at EOF")
-            return
-        crc, length = _HEADER.unpack_from(raw, pos)
-        if length > MAX_RECORD_BYTES:
-            yield (CORRUPT, pos, f"record length {length} exceeds max")
-            return
-        if n - pos - _HEADER.size < length:
-            yield (TORN, pos, "torn payload at EOF")
-            return
-        data = raw[pos + _HEADER.size : pos + _HEADER.size + length]
-        if zlib.crc32(data) & 0xFFFFFFFF != crc:
-            yield (CORRUPT, pos, f"crc mismatch at offset {pos}")
-            return
-        yield ("record", pos, data)
-        pos += _HEADER.size + length
-    yield (CLEAN, pos, "")
+    exactly one terminal (TORN|CORRUPT|CLEAN, offset, detail); with
+    resync, corrupt regions become (SKIPPED, start, end) and the walk
+    continues — see libs/autofile.walk_frames."""
+    return autofile.walk_frames(raw, MAX_RECORD_BYTES, resync=resync)
 
 
 def decode_records(raw: bytes) -> Iterator[dict]:
@@ -76,6 +68,27 @@ def decode_records(raw: bytes) -> Iterator[dict]:
             raise WALCorruptionError(data)
         else:  # TORN / CLEAN end iteration quietly
             return
+
+
+def decode_records_resync(raw: bytes) -> Tuple[List[dict], dict]:
+    """Tolerant decode: skip corrupt regions (bit-rot, multi-record torn
+    spans) via crc resync and return (records, report) with
+    {'skipped_regions', 'skipped_bytes', 'torn'} so the caller can log
+    exactly what history was lost.  An undecodable payload INSIDE a
+    crc-valid frame still raises — the crc matched, so that is a codec
+    bug, not disk damage."""
+    out: List[dict] = []
+    report = {"records": 0, "skipped_regions": 0, "skipped_bytes": 0, "torn": 0}
+    for kind, pos, detail in walk_records(raw, resync=True):
+        if kind == "record":
+            out.append(codec.loads(detail))
+            report["records"] += 1
+        elif kind == SKIPPED:
+            report["skipped_regions"] += 1
+            report["skipped_bytes"] += detail - pos
+        elif kind == TORN:
+            report["torn"] = 1
+    return out, report
 
 
 def torn_tail_offset(raw: bytes) -> Optional[int]:
@@ -96,6 +109,10 @@ class WAL:
         self.group = Group(head_path, head_size_limit=head_size_limit)
         self.flush_interval = 2.0
         self._last_flush = 0.0
+        #: cumulative resync accounting from tolerant replays (observability:
+        #: `storage_info` / debug bundles surface it)
+        self.corrupt_regions_skipped = 0
+        self.corrupt_bytes_skipped = 0
         # Crash repair: a torn tail record (power loss mid-write) would sit
         # between old and NEW appends and read as mid-file corruption later.
         # Truncate exactly the tear; genuine corruption is left in place to
@@ -130,12 +147,26 @@ class WAL:
 
     # -- reading -----------------------------------------------------------
     def all_records(self) -> List[dict]:
+        """STRICT decode — mid-file corruption raises (the fuzz-pinned
+        contract: direct inspection must never silently drop history)."""
         return list(decode_records(self.group.read_all()))
+
+    def replay_records(self) -> List[dict]:
+        """Tolerant decode for the node's replay path: resync past
+        corrupt regions rather than wedging the restart, accumulating the
+        skip accounting on the WAL object."""
+        records, report = decode_records_resync(self.group.read_all())
+        self.corrupt_regions_skipped += report["skipped_regions"]
+        self.corrupt_bytes_skipped += report["skipped_bytes"]
+        return records
 
     def search_for_end_height(self, height: int) -> Tuple[Optional[List[dict]], bool]:
         """Records AFTER the EndHeight(height) marker, or (None, False)
-        (wal.go:231).  height=0 accepts a fresh WAL (no marker needed)."""
-        records = self.all_records()
+        (wal.go:231).  height=0 accepts a fresh WAL (no marker needed).
+        Uses the TOLERANT decode: catchup after a crash onto a bit-rotted
+        WAL replays every surviving record instead of refusing to boot —
+        skipped regions are counted on the WAL for the operator."""
+        records = self.replay_records()
         if height == 0:
             # gr.CurHeight == 0 special case: start of WAL counts as marker
             found = True
@@ -170,6 +201,9 @@ class NilWAL:
         pass
 
     def all_records(self):
+        return []
+
+    def replay_records(self):
         return []
 
     def search_for_end_height(self, height: int):

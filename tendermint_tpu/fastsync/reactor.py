@@ -64,6 +64,12 @@ class BlockchainReactor(Reactor):
         self._started_at = 0.0
         self._wake: Optional[asyncio.Event] = None
         self.statesync_metrics = None  # node wires StateSyncMetrics (phase gauge)
+        # self-healing refill: quarantined (corrupt) heights to re-fetch
+        # from peers — runs in EVERY mode, not just fast sync; the store
+        # already answers None for them, so peers are the only source
+        self.refill_heights: set = set()
+        self._refill_wake: Optional[asyncio.Event] = None
+        self.refilled = 0
 
     def get_channels(self):
         return [
@@ -78,9 +84,13 @@ class BlockchainReactor(Reactor):
     async def on_start(self) -> None:
         self._started_at = time.monotonic()
         self._wake = asyncio.Event()
+        self._refill_wake = asyncio.Event()
         if self.fast_sync and not self.wait_statesync:
             self.spawn(self._pool_routine(), "pool")
         self.spawn(self._status_broadcast_routine(), "status-bcast")
+        self.spawn(self._refill_routine(), "refill")
+        if self.refill_heights:
+            self._refill_wake.set()
 
     def _wake_pool(self) -> None:
         if self._wake is not None:
@@ -142,12 +152,19 @@ class BlockchainReactor(Reactor):
         elif kind == "block_request":
             await self._serve_block(peer, msg["height"])
         elif kind == "block_response":
-            if not self.fast_sync:
+            if not self.fast_sync and not self.refill_heights:
+                # steady state with nothing pending: an unsolicited block
+                # must not cost a multi-MB deserialize on the event loop
                 return
             try:
                 block = Block.deserialize(msg["block"])
             except Exception:
                 await self._report(behaviour.bad_message(peer.id, "undecodable block response"))
+                return
+            if block.height in self.refill_heights:
+                await self._try_refill(peer, block)
+                return
+            if not self.fast_sync:
                 return
             if self.scheduler.block_received(peer.id, block.height):
                 self.processor.add_block(block.height, block, peer.id)
@@ -157,8 +174,80 @@ class BlockchainReactor(Reactor):
                     behaviour.message_out_of_order(peer.id, "unsolicited block")
                 )
         elif kind == "no_block_response":
-            self.scheduler.no_block(peer.id, msg["height"])
-            self._wake_pool()
+            if self.fast_sync:
+                self.scheduler.no_block(peer.id, msg["height"])
+                self._wake_pool()
+            # refill: a "don't have it" just means the retry tick asks
+            # someone else (or the same peer later)
+
+    # -- quarantine refill (self-healing store) -----------------------------
+    REFILL_RETRY_INTERVAL = 1.0
+
+    def request_refill(self, heights) -> None:
+        """Queue quarantined heights for re-fetch from peers.  Callable
+        from any mode (boot scan, live integrity scan RPC): consensus can
+        be serving at the tip while history heals underneath."""
+        fresh = set(heights) - self.refill_heights
+        if not fresh:
+            return
+        self.refill_heights |= fresh
+        self.log.warn(
+            "refill queued for quarantined blocks", heights=sorted(fresh)
+        )
+        if self._refill_wake is not None:
+            self._refill_wake.set()
+
+    async def _refill_routine(self) -> None:
+        """Re-request quarantined heights round-robin across peers until
+        each arrives and verifies against the surviving identity.  Block
+        responses route through _try_refill; this loop only (re)issues
+        requests on a slow tick — at most len(heights) small messages per
+        interval, nothing at all while the set is empty."""
+        rr = 0
+        while True:
+            if not self.refill_heights:
+                await wait_event(self._refill_wake, 3600.0)
+                self._refill_wake.clear()
+                continue
+            peers = self.switch.peer_list() if self.switch is not None else []
+            if peers:
+                for height in sorted(self.refill_heights):
+                    peer = peers[rr % len(peers)]
+                    rr += 1
+                    peer.try_send(
+                        BLOCKCHAIN_CHANNEL, _enc("block_request", {"height": height})
+                    )
+            await wait_event(self._refill_wake, self.REFILL_RETRY_INTERVAL)
+            self._refill_wake.clear()
+
+    async def _try_refill(self, peer, block) -> None:
+        """A block arrived for a quarantined height: restore_block verifies
+        it against the strongest surviving identity (meta / commit hash)
+        and lifts the quarantine; a hash mismatch is a bad peer, not a
+        reason to wedge the refill."""
+        height = block.height
+        if self.block_store.quarantine_expected_hash(height) is None:
+            # every identity source rotted too: nothing to verify a peer
+            # copy against — leave the height quarantined (served as
+            # "don't have it") rather than trust an unverifiable block,
+            # and stop asking for what we cannot accept
+            self.log.error(
+                "refill impossible: no surviving identity", height=height
+            )
+            self.refill_heights.discard(height)
+            return
+        try:
+            self.block_store.restore_block(height, block)
+        except ValueError as e:
+            self.log.warn("refill rejected", height=height, peer=peer.id[:8], err=str(e))
+            await self._report(behaviour.bad_message(peer.id, "invalid refill block"))
+            return
+        self.refill_heights.discard(height)
+        self.refilled += 1
+        self.log.info(
+            "quarantined block refilled from peer",
+            height=height, peer=peer.id[:8], remaining=len(self.refill_heights),
+        )
 
     async def _serve_block(self, peer, height: int) -> None:
         block = self.block_store.load_block(height)

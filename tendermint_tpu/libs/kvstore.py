@@ -81,12 +81,17 @@ class MemDB(KVStore):
         yield from snapshot
 
     def write_batch(self, sets, deletes=()) -> None:
+        # materialize + copy BEFORE mutating: an iterable that raises (or a
+        # value that fails bytes()) mid-batch must leave the store exactly
+        # as it was — write_batch promises all-or-nothing
+        staged = [(k, bytes(v)) for k, v in sets]
+        staged_deletes = list(deletes)
         with self._lock:
-            for k, v in sets:
+            for k, v in staged:
                 if k not in self._data:
                     bisect.insort(self._keys, k)
-                self._data[k] = bytes(v)
-            for k in deletes:
+                self._data[k] = v
+            for k in staged_deletes:
                 self._delete_locked(k)
 
 
@@ -94,6 +99,7 @@ class SQLiteDB(KVStore):
     """Durable backend over sqlite3 with WAL journaling."""
 
     def __init__(self, path: str):
+        self.path = path  # storage_info / debug bundles report per-store usage
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
@@ -108,15 +114,32 @@ class SQLiteDB(KVStore):
             row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
         return bytes(row[0]) if row else None
 
+    def _rollback(self) -> None:
+        """Best-effort rollback after a failed write: without it the NEXT
+        commit (any later set) would flush the half-applied statements —
+        a crashed batch observed half-applied later."""
+        try:
+            self._conn.rollback()
+        except sqlite3.Error:
+            pass
+
     def set(self, key: bytes, value: bytes) -> None:
         with self._lock:
-            self._conn.execute("INSERT OR REPLACE INTO kv VALUES (?, ?)", (key, value))
-            self._conn.commit()
+            try:
+                self._conn.execute("INSERT OR REPLACE INTO kv VALUES (?, ?)", (key, value))
+                self._conn.commit()
+            except BaseException:
+                self._rollback()
+                raise
 
     def delete(self, key: bytes) -> None:
         with self._lock:
-            self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
-            self._conn.commit()
+            try:
+                self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
+                self._conn.commit()
+            except BaseException:
+                self._rollback()
+                raise
 
     @staticmethod
     def _prefix_upper_bound(prefix: bytes) -> Optional[bytes]:
@@ -146,11 +169,20 @@ class SQLiteDB(KVStore):
                 yield bytes(k), bytes(v)
 
     def write_batch(self, sets, deletes=()) -> None:
+        # atomicity across a crash: every statement inside ONE transaction,
+        # explicit rollback on ANY failure (incl. injected fsync/commit
+        # errors) — a batch must never be observable half-applied
+        staged = list(sets)
+        staged_deletes = [(k,) for k in deletes]
         with self._lock:
-            self._conn.executemany("INSERT OR REPLACE INTO kv VALUES (?, ?)", list(sets))
-            if deletes:
-                self._conn.executemany("DELETE FROM kv WHERE k = ?", [(k,) for k in deletes])
-            self._conn.commit()
+            try:
+                self._conn.executemany("INSERT OR REPLACE INTO kv VALUES (?, ?)", staged)
+                if staged_deletes:
+                    self._conn.executemany("DELETE FROM kv WHERE k = ?", staged_deletes)
+                self._conn.commit()
+            except BaseException:
+                self._rollback()
+                raise
 
     def close(self) -> None:
         with self._lock:

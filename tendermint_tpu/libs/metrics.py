@@ -506,7 +506,7 @@ class ChaosMetrics:
         if registry is None:
             for name in (
                 "links_degraded", "msgs_dropped", "msgs_delayed",
-                "clock_skew_seconds", "twin_votes",
+                "clock_skew_seconds", "twin_votes", "disk_faults",
             ):
                 setattr(self, name, _NOP)
             return
@@ -536,6 +536,73 @@ class ChaosMetrics:
         self.twin_votes = c(
             "twin_votes", "Conflicting votes signed by the twin double-signer."
         )
+        self.disk_faults = _BoundLabels(
+            Counter(
+                "disk_faults",
+                "Injected disk faults (chaos/disk.py) by kind.",
+                namespace=NAMESPACE, subsystem="chaos", registry=registry,
+                labelnames=("chain_id", "kind"),
+            ),
+            chain_id=chain_id,
+        )
+
+
+class StorageMetrics:
+    """Store integrity + disk-fault telemetry (subsystem `storage`; no
+    reference counterpart — goleveldb's CRCs are invisible to operators).
+    `write_errors`/`corruptions` are counters per store name (blockstore,
+    state, wal, mempool-wal, privval, sign, consensus); `quarantined` is
+    the live count of block heights answering None pending a peer refill;
+    `integrity_scan_seconds` is the last sweep's duration and `free_bytes`
+    the data-dir headroom the disk_pressure alarm watches."""
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        if registry is None:
+            for name in (
+                "write_errors", "corruptions", "quarantined", "refills",
+                "integrity_scan_seconds", "free_bytes",
+            ):
+                setattr(self, name, _NOP)
+            return
+        from prometheus_client import Counter, Gauge
+
+        kw = dict(namespace=NAMESPACE, subsystem="storage", registry=registry)
+        self.write_errors = _BoundLabels(
+            Counter(
+                "write_errors",
+                "Persistence write/fsync failures (ENOSPC, EIO) by store.",
+                labelnames=("chain_id", "store"), **kw,
+            ),
+            chain_id=chain_id,
+        )
+        self.corruptions = _BoundLabels(
+            Counter(
+                "corruptions",
+                "Detected corrupt entries (seal/crc/hash mismatch) by store.",
+                labelnames=("chain_id", "store"), **kw,
+            ),
+            chain_id=chain_id,
+        )
+        self.quarantined = Gauge(
+            "quarantined_blocks",
+            "Block heights quarantined as corrupt, pending peer refill.",
+            labelnames=("chain_id",), **kw,
+        ).labels(chain_id=chain_id)
+        self.refills = Counter(
+            "refills",
+            "Quarantined blocks restored from verified peer copies.",
+            labelnames=("chain_id",), **kw,
+        ).labels(chain_id=chain_id)
+        self.integrity_scan_seconds = Gauge(
+            "integrity_scan_seconds",
+            "Duration of the last block-store integrity scan.",
+            labelnames=("chain_id",), **kw,
+        ).labels(chain_id=chain_id)
+        self.free_bytes = Gauge(
+            "free_bytes",
+            "Free bytes on the data directory's filesystem (watchdog probe).",
+            labelnames=("chain_id",), **kw,
+        ).labels(chain_id=chain_id)
 
 
 class HealthMetrics:
@@ -615,6 +682,7 @@ class MetricsProvider:
         self.evidence = EvidenceMetrics(self.registry, chain_id)
         self.chaos = ChaosMetrics(self.registry, chain_id)
         self.health = HealthMetrics(self.registry, chain_id)
+        self.storage = StorageMetrics(self.registry, chain_id)
 
     def exposition(self) -> bytes:
         if self.registry is None:

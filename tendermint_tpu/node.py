@@ -78,8 +78,27 @@ class Node(Service):
 
         backend = db_backend or config.base.db_backend
         home = None if backend == "memdb" else config.home
-        self.block_store = BlockStore(open_db("blockstore", home, backend))
-        self.state_db = open_db("state", home, backend)
+        # chaos: the disk as a fault domain — every store/WAL is wrapped
+        # so per-store seeded ENOSPC/EIO/torn/fsync-lie/bitrot policies
+        # can be injected at runtime (scenario DSL, InProcRig, the
+        # unsafe_chaos_disk RPC)
+        self.disk_faults = None
+        if config.chaos.enabled:
+            from .chaos.disk import DiskFaultTable
+
+            self.disk_faults = DiskFaultTable(seed=config.chaos.seed)
+        # one sink for every storage-fault observation (write errors,
+        # detected corruption, quarantines, persistence halts) + the
+        # free-space probe — the watchdog's disk_fault/disk_pressure
+        # detectors and the storage_info RPC route read it
+        from .libs.watchdog import StorageHealth
+
+        self.storage_health = StorageHealth(
+            data_dir=config.db_dir() if home is not None else None
+        )
+        self.block_store = BlockStore(self._wrap_db(open_db("blockstore", home, backend), "blockstore"))
+        self.block_store.storage_health = self.storage_health
+        self.state_db = self._wrap_db(open_db("state", home, backend), "state")
         self.state_store = StateStore(self.state_db)
 
         self.event_bus = EventBus()
@@ -93,7 +112,7 @@ class Node(Service):
             # opened only for the builtin kvstore — a socket/gRPC app must
             # not grow a stray empty db under home/data
             app_db=(
-                open_db("app", home, backend)
+                self._wrap_db(open_db("app", home, backend), "app")
                 if config.base.proxy_app == "kvstore"
                 else None
             ),
@@ -115,6 +134,7 @@ class Node(Service):
         self.mempool: Optional[Mempool] = None
         self.consensus: Optional[ConsensusState] = None
         self.consensus_reactor = None
+        self.blockchain_reactor = None
         self.statesync_reactor = None
         self.switch = None
         self.node_key = None
@@ -141,6 +161,21 @@ class Node(Service):
             sample_high_rate=config.instrumentation.trace_sample_high_rate,
         )
 
+    def _wrap_db(self, db, store: str):
+        """Chaos disk-fault wrapper (identity when chaos is off)."""
+        if self.disk_faults is None:
+            return db
+        from .chaos.disk import FaultyDB
+
+        return FaultyDB(db, self.disk_faults, store)
+
+    def _wrap_group(self, group, store: str):
+        if self.disk_faults is None:
+            return group
+        from .chaos.disk import FaultyGroup
+
+        return FaultyGroup(group, self.disk_faults, store)
+
     async def on_start(self) -> None:
         cfg = self.config
         # metrics provider (node/node.go:128) — per-node registry; built
@@ -150,6 +185,32 @@ class Node(Service):
         self.metrics_provider = MetricsProvider(
             cfg.instrumentation.prometheus, self.genesis_doc.chain_id
         )
+        self.storage_health.metrics = self.metrics_provider.storage
+        if self.disk_faults is not None:
+            self.disk_faults.metrics = self.metrics_provider.chaos
+            self.disk_faults.recorder = self.flight_recorder
+        # boot-time store integrity sweep: turn latent bit-rot into
+        # quarantine entries BEFORE anything reads the store as truth
+        # (the fastsync refill kick below re-fetches them from peers).
+        # Off the event loop — an archive-node sweep is real IO+hashing.
+        if cfg.storage.integrity_scan_on_boot and self.block_store.height() > 0:
+            limit = cfg.storage.integrity_scan_limit
+            report = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.block_store.integrity_scan(limit)
+            )
+            if report["corrupt"] or report["quarantined"]:
+                self.log.warn(
+                    "boot integrity scan found corruption",
+                    corrupt=report["corrupt"],
+                    quarantined=report["quarantined"],
+                    checked=report["checked"],
+                    ms=report["ms"],
+                )
+            else:
+                self.log.info(
+                    "boot integrity scan clean",
+                    checked=report["checked"], ms=report["ms"],
+                )
         from .crypto import backend as _crypto_backend
 
         self.metrics_provider.verify.backend_tier.set(_crypto_backend.active_tier())
@@ -194,6 +255,7 @@ class Node(Service):
                 size_limit=cfg.instrumentation.flight_spool_size_limit,
                 node=cfg.base.moniker,
             )
+            self.flight_spool._group = self._wrap_group(self.flight_spool._group, "spool")
             self.flight_spool.install_crash_hooks()
             self.spawn(self._spool_flush_loop(), name="flight-spool")
         # scheduler profiler, started BEFORE any service spawns tasks so
@@ -278,8 +340,10 @@ class Node(Service):
         self.mempool = Mempool(
             self.proxy_app.mempool(), cfg.mempool.as_dict(), height=self.state.last_block_height
         )
+        self.mempool.storage_health = self.storage_health
         if cfg.mempool.wal_dir and cfg.base.db_backend != "memdb":
             self.mempool.init_wal(cfg.mempool_wal_dir())
+            self.mempool._wal = self._wrap_group(self.mempool._wal, "mempool-wal")
         if cfg.consensus.wait_for_txs():
             self.mempool.enable_txs_available()
         if cfg.mempool.sig_precheck and self.async_verifier is not None:
@@ -342,9 +406,11 @@ class Node(Service):
             self.flight_recorder._wall_ns_fn = self.chaos_clock.time_ns
         if self.priv_validator is not None:
             self.consensus.set_priv_validator(self.priv_validator)
+        self.consensus.storage_health = self.storage_health
         cfg.ensure_dirs()
         if cfg.base.db_backend != "memdb":
             self.consensus.wal = WAL(cfg.wal_file())
+            self.consensus.wal.group = self._wrap_group(self.consensus.wal.group, "wal")
 
         # RPC (node/node.go:766)
         if cfg.rpc.laddr:
@@ -533,6 +599,12 @@ class Node(Service):
             # advertise the actually-bound address (PEX peers gossip it)
             node_info.listen_addr = cfg.p2p.external_address or transport.listen_addr
             await self.switch.start()  # starts reactors, incl. consensus
+            # self-healing kick: heights the boot scan (or a previous run)
+            # quarantined are re-fetched from peers through the fastsync
+            # channel while the node serves at the tip
+            quarantined = self.block_store.quarantined()
+            if quarantined:
+                self.blockchain_reactor.request_refill(quarantined)
             if cfg.chaos.enabled and cfg.chaos.twin and self.priv_validator is not None:
                 # arm the twin AFTER the switch is live: its equivocations
                 # broadcast over the consensus vote channel
@@ -581,6 +653,8 @@ class Node(Service):
                 shed_rate=inst.watchdog_shed_rate,
                 clock_drift_seconds=inst.watchdog_clock_drift_seconds,
                 min_peers=inst.watchdog_min_peers,
+                disk_free_bytes=cfg.storage.min_free_bytes,
+                disk_fault_hold=inst.watchdog_disk_fault_hold,
                 metrics=self.metrics_provider.health,
                 recorder=self.flight_recorder,
                 autodump_fn=autodump_fn,

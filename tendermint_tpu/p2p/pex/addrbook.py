@@ -345,6 +345,11 @@ class AddrBook:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.file_path)
+        # rename atomicity needs a directory fsync to survive power loss,
+        # or the whole book can vanish (see libs/autofile.fsync_dir)
+        from ...libs.autofile import fsync_dir
+
+        fsync_dir(self.file_path)
 
     def load(self) -> None:
         try:

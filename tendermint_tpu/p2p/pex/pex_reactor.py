@@ -69,7 +69,10 @@ class PEXReactor(Reactor):
         self.spawn(self._ensure_peers_routine(), "ensure-peers")
 
     async def on_stop(self) -> None:
-        self.book.save()
+        # off the loop: the save is two fsyncs (file + directory — rename
+        # durability) and stop runs mid-teardown while peer task
+        # cancellation cascades drain; blocking the loop here starves them
+        await asyncio.get_event_loop().run_in_executor(None, self.book.save)
 
     # -- peer lifecycle ----------------------------------------------------
 

@@ -44,8 +44,15 @@ class DoubleSignError(Exception):
 
 
 def _atomic_write_json(path: str, obj: dict) -> None:
-    """tempfile + fsync + rename — the state file must never be torn
-    (libs/tempfile.WriteFileAtomic equivalent)."""
+    """tempfile + fsync + rename + DIRECTORY fsync — the state file must
+    never be torn (libs/tempfile.WriteFileAtomic equivalent).  The dir
+    fsync matters: rename atomicity without it can lose the ENTIRE file
+    on power loss (the new directory entry never reaches the platter),
+    and for the last-sign state a vanished file after a crash is a
+    double-sign vector — the restarted node would believe it never
+    signed."""
+    from ..libs.autofile import fsync_dir
+
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".pv-")
@@ -61,6 +68,7 @@ def _atomic_write_json(path: str, obj: dict) -> None:
         except OSError:
             pass
         raise
+    fsync_dir(path)
 
 
 @dataclass
@@ -280,15 +288,30 @@ class FilePV(PrivValidator):
     def _save_signed(
         self, height: int, round_: int, step: int, sign_bytes: bytes, sig: bytes, ts_ns: int
     ) -> None:
-        """privval/file.go:415 — persist BEFORE the signature escapes."""
+        """privval/file.go:415 — persist BEFORE the signature escapes.
+
+        If the save fails (ENOSPC/EIO on the state file), the in-memory
+        state is ROLLED BACK and the error propagates: the signature has
+        not escaped this process, so refusing the sign is safe — and the
+        rollback keeps the privval able to sign this HRS once the disk
+        heals, instead of wedging on a phantom "conflicting" entry for a
+        signature nobody ever saw.  (`_atomic_write_json` is atomic: on
+        failure the on-disk state is still the OLD one the rollback
+        restores consistency with.)"""
         lss = self.last_sign_state
+        prev = (lss.height, lss.round, lss.step, lss.sign_bytes, lss.signature, lss.timestamp_ns)
         lss.height = height
         lss.round = round_
         lss.step = step
         lss.sign_bytes = sign_bytes
         lss.signature = sig
         lss.timestamp_ns = ts_ns
-        lss.save()
+        try:
+            lss.save()
+        except BaseException:
+            (lss.height, lss.round, lss.step,
+             lss.sign_bytes, lss.signature, lss.timestamp_ns) = prev
+            raise
 
     def _only_differs_by_timestamp(self, vote: Vote, chain_id: str) -> Tuple[int, bool]:
         """privval/file.go:438 checkVotesOnlyDifferByTimestamp: rebuild the

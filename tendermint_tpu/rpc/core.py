@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import os
 import time
 from typing import Any, Dict, Optional
 
@@ -62,6 +63,7 @@ class RPCCore:
         "consensus_state",
         "dump_consensus_state",
         "dump_flight_recorder",
+        "storage_info",
         "unconfirmed_txs",
         "num_unconfirmed_txs",
         "broadcast_tx_async",
@@ -85,6 +87,10 @@ class RPCCore:
         "unsafe_chaos_heal",
         "unsafe_chaos_clock_skew",
         "unsafe_chaos_status",
+        "unsafe_chaos_disk",
+        "unsafe_chaos_rot",
+        # store integrity (unsafe: it holds the store lock for a sweep)
+        "unsafe_store_integrity_scan",
     )
     UNSAFE = {
         "dial_peers",
@@ -97,6 +103,9 @@ class RPCCore:
         "unsafe_chaos_heal",
         "unsafe_chaos_clock_skew",
         "unsafe_chaos_status",
+        "unsafe_chaos_disk",
+        "unsafe_chaos_rot",
+        "unsafe_store_integrity_scan",
     }
 
     #: broadcast routes gated by ingress admission control
@@ -816,6 +825,108 @@ class RPCCore:
             "policies": table.policies() if table is not None else {},
             "counters": table.counters() if table is not None else {},
         }
+
+    async def unsafe_chaos_disk(
+        self, kind: str, store: str = "*", p: float = 1.0
+    ) -> dict:
+        """Set (or with kind="heal" clear) a disk-fault policy on this
+        node's stores — the process rig's handle on chaos/disk.py.  kind
+        in enospc|eio|eio_fsync|torn|fsync_lie|bitrot|heal; store names a
+        single store or "*"."""
+        self._require_chaos()
+        table = getattr(self.node, "disk_faults", None)
+        if table is None:
+            raise RPCError(INTERNAL_ERROR, "no disk-fault table ([chaos] enabled?)")
+        from ..chaos.disk import policy_for
+
+        if kind == "heal":
+            table.heal(None if store == "*" else store)
+        else:
+            try:
+                table.set_policy(store, policy_for(kind, p))
+            except ValueError as e:
+                raise RPCError(INVALID_PARAMS, str(e))
+        return {"policies": table.policies(), "counters": table.counters()}
+
+    async def unsafe_chaos_rot(
+        self, height: int, store: str = "blockstore", part: int = 0
+    ) -> dict:
+        """Persistent seeded bit-rot: flip one byte inside the stored
+        block part (height, part) — restart-surviving cell damage the
+        integrity scan must detect and quarantine."""
+        self._require_chaos()
+        if store != "blockstore":
+            raise RPCError(INVALID_PARAMS, f"rot supports 'blockstore' only, got {store!r}")
+        from ..chaos.disk import rot_block_store
+
+        seed = getattr(self.node.config.chaos, "seed", 0)
+        try:
+            info = rot_block_store(self.node.block_store, height, seed=seed, part_index=part)
+        except ValueError as e:
+            raise RPCError(INVALID_PARAMS, str(e))
+        return {"rotted": info, "height": height}
+
+    # -- store integrity ----------------------------------------------------
+
+    async def storage_info(self) -> dict:
+        """Per-store persistence posture: fault counters + halts (the
+        StorageHealth summary incl. free space), quarantine state, last
+        integrity scan, per-store disk usage and WAL/spool chunk counts —
+        the live half of a debug bundle's storage section."""
+        node = self.node
+        out: dict = {"health": node.storage_health.summary()}
+        bs = node.block_store
+        out["blockstore"] = {
+            "base": bs.base(),
+            "height": bs.height(),
+            "quarantined": bs.quarantined(),
+            "last_scan": bs.last_scan,
+        }
+        from ..libs.autofile import dir_usage, group_disk_stats
+
+        cfg = node.config
+        out["disk_usage"] = dir_usage(cfg.db_dir())
+        wals = {}
+        cs_stats = group_disk_stats(cfg.wal_file())
+        if cs_stats is not None:
+            wal = getattr(node.consensus, "wal", None)
+            cs_stats["corrupt_regions_skipped"] = getattr(wal, "corrupt_regions_skipped", 0)
+            cs_stats["corrupt_bytes_skipped"] = getattr(wal, "corrupt_bytes_skipped", 0)
+            wals["consensus_wal"] = cs_stats
+        if cfg.mempool.wal_dir:
+            mp_stats = group_disk_stats(os.path.join(cfg.mempool_wal_dir(), "wal"))
+            if mp_stats is not None:
+                wals["mempool_wal"] = mp_stats
+        spool_stats = group_disk_stats(cfg.flight_spool_file())
+        if spool_stats is not None:
+            wals["flight_spool"] = spool_stats
+        out["wals"] = wals
+        if node.disk_faults is not None:
+            out["chaos"] = {
+                "policies": node.disk_faults.policies(),
+                "injected": node.disk_faults.counters(),
+            }
+        br = getattr(node, "blockchain_reactor", None)
+        if br is not None:
+            out["refill"] = {
+                "pending": sorted(br.refill_heights),
+                "refilled": br.refilled,
+            }
+        return out
+
+    async def unsafe_store_integrity_scan(self, limit: int = 0) -> dict:
+        """Run the block-store integrity sweep NOW (on an executor
+        thread), quarantining anything corrupt and kicking the peer
+        refill.  `limit` bounds the sweep to the most recent N heights
+        (0 = base..tip)."""
+        node = self.node
+        report = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: node.block_store.integrity_scan(limit)
+        )
+        br = getattr(node, "blockchain_reactor", None)
+        if br is not None and report["quarantined"]:
+            br.request_refill(report["quarantined"])
+        return report
 
     # -- profiling/debug routes (routes.go:48-56; cProfile stands in for
     # pprof, an asyncio task dump for the goroutine dump) ------------------

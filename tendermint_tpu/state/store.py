@@ -39,18 +39,26 @@ class StateStore:
         """SaveState (state/store.go:97): persists state + the validator set
         / params that become active at the *next* height, using the
         pointer-to-last-changed scheme so a 10k-validator set isn't
-        rewritten every block."""
+        rewritten every block.
+
+        ONE atomic batch: the per-height validator/params records and the
+        state key land together or not at all — a crash (or injected
+        ENOSPC) between separate sets used to leave the validator records
+        for height H+2 on disk with the state key still at H-1, a
+        half-applied save the handshake then reads as truth."""
         next_height = state.last_block_height + 1
+        sets = []
         if next_height == 1:
             # genesis bootstrap: heights 1 and 2 both known at this point
-            self._save_validators(next_height, next_height, state.validators)
-        self._save_validators(
-            next_height + 1, state.last_height_validators_changed, state.next_validators
+            self._stage_validators(sets, next_height, next_height, state.validators)
+        self._stage_validators(
+            sets, next_height + 1, state.last_height_validators_changed, state.next_validators
         )
-        self._save_params(
-            next_height, state.last_height_consensus_params_changed, state.consensus_params
+        self._stage_params(
+            sets, next_height, state.last_height_consensus_params_changed, state.consensus_params
         )
-        self.db.set(_K_STATE, state.bytes())
+        sets.append((_K_STATE, state.bytes()))
+        self.db.write_batch(sets)
 
     def load(self) -> Optional[State]:
         raw = self.db.get(_K_STATE)
@@ -70,14 +78,17 @@ class StateStore:
         whose history does NOT exist locally: full (non-pointer) validator
         records for the heights consensus and RPC will touch next, plus a
         full consensus-params record, so the pointer-to-last-changed
-        scheme never dereferences a height below the snapshot."""
+        scheme never dereferences a height below the snapshot.  Atomic
+        for the same reason save() is."""
         h = state.last_block_height
+        sets = []
         if state.last_validators is not None and state.last_validators.size() > 0:
-            self._save_validators(h, h, state.last_validators)
-        self._save_validators(h + 1, h + 1, state.validators)
-        self._save_validators(h + 2, h + 2, state.next_validators)
-        self._save_params(h + 1, h + 1, state.consensus_params)
-        self.db.set(_K_STATE, state.bytes())
+            self._stage_validators(sets, h, h, state.last_validators)
+        self._stage_validators(sets, h + 1, h + 1, state.validators)
+        self._stage_validators(sets, h + 2, h + 2, state.next_validators)
+        self._stage_params(sets, h + 1, h + 1, state.consensus_params)
+        sets.append((_K_STATE, state.bytes()))
+        self.db.write_batch(sets)
 
     # -- historical validator sets ----------------------------------------
     # Full-set checkpoint cadence for unchanged validator sets (reference
@@ -87,13 +98,15 @@ class StateStore:
     # historical loads O(height) each.  A checkpoint bounds the replay.
     VALSET_CHECKPOINT_INTERVAL = 1024
 
-    def _save_validators(self, height: int, last_changed: int, vals: ValidatorSet) -> None:
+    def _stage_validators(
+        self, sets: list, height: int, last_changed: int, vals: ValidatorSet
+    ) -> None:
         if height == last_changed or height % self.VALSET_CHECKPOINT_INTERVAL == 0:
             payload = {"last_changed": last_changed, "validators": vals.to_dict()}
         else:
             # pointer record only — the full set lives at last_changed
             payload = {"last_changed": last_changed, "validators": None}
-        self.db.set(_k_validators(height), codec.dumps(payload))
+        sets.append((_k_validators(height), codec.dumps(payload)))
 
     def load_validators(self, height: int) -> Optional[ValidatorSet]:
         """LoadValidators (state/store.go:295): follow the pointer to the
@@ -128,12 +141,14 @@ class StateStore:
         return codec.loads(raw) if raw else None
 
     # -- historical consensus params --------------------------------------
-    def _save_params(self, height: int, last_changed: int, params: ConsensusParams) -> None:
+    def _stage_params(
+        self, sets: list, height: int, last_changed: int, params: ConsensusParams
+    ) -> None:
         if height == last_changed:
             payload = {"last_changed": last_changed, "params": params.to_dict()}
         else:
             payload = {"last_changed": last_changed, "params": None}
-        self.db.set(_k_params(height), codec.dumps(payload))
+        sets.append((_k_params(height), codec.dumps(payload)))
 
     def load_consensus_params(self, height: int) -> Optional[ConsensusParams]:
         raw = self.db.get(_k_params(height))

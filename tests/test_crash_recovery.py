@@ -232,6 +232,45 @@ class TestByzantineProposer:
             await stop_net(nodes)
 
 
+class TestRestartOverWALBitRot:
+    async def test_node_restarts_and_commits_over_mid_wal_corruption(self, tmp_path):
+        """CrashingWAL-rig extension for the hostile-disk contract: a solo
+        validator stops cleanly, ONE byte inside an early WAL record rots
+        on disk, and the restart must come up and keep committing — the
+        tolerant replay resyncs past the damaged region (and counts it)
+        instead of refusing to boot or replaying garbage."""
+        from tendermint_tpu.libs.autofile import walk_frames
+
+        pv = MockPV()
+        gen = _gen([pv], chain="walrot-chain")
+        cfg = _solo_cfg(tmp_path, "walrot")
+        node = Node(cfg, gen, priv_validator=pv)
+        await node.start()
+
+        async def past(n, h):
+            while n.block_store.height() < h:
+                await asyncio.sleep(0.02)
+
+        await asyncio.wait_for(past(node, 3), 30.0)
+        stopped_height = node.block_store.height()
+        await node.stop()
+
+        wal_path = cfg.wal_file()
+        raw = bytearray(open(wal_path, "rb").read())
+        offsets = [pos for k, pos, _ in walk_frames(bytes(raw)) if k == "record"]
+        assert len(offsets) > 4
+        raw[offsets[1] + 12] ^= 0xFF  # rot an EARLY record, mid-file
+        open(wal_path, "wb").write(bytes(raw))
+
+        node2 = Node(cfg, gen, priv_validator=pv)
+        await node2.start()
+        try:
+            await asyncio.wait_for(past(node2, stopped_height + 2), 30.0)
+            assert node2.consensus.wal.corrupt_regions_skipped >= 1
+        finally:
+            await node2.stop()
+
+
 class TestWALFuzz:
     """consensus/wal_fuzz.go flavor: corrupted/torn WALs must either
     recover cleanly (torn tail = crash mid-write) or fail LOUDLY

@@ -209,13 +209,17 @@ class TestMempoolWAL:
             with pytest.raises(Exception):
                 await mp.check_tx(b"a=1")  # cache dup: NOT journaled again
         finally:
+            txs_before_close = mp.wal_txs()
             mp.close_wal()
             await client.stop()
-        lines = open(tmp_path / "mwal" / "wal", "rb").read().splitlines()
-        assert [bytes.fromhex(line.decode()) for line in lines] == [
-            b"a=1",
-            b"binary\nwith=newline",
-        ]
+        assert txs_before_close == [b"a=1", b"binary\nwith=newline"]
+        # the on-disk journal is crc-framed (libs/autofile frames), so
+        # replay survives torn tails AND mid-file bit-rot
+        from tendermint_tpu.libs import autofile
+
+        raw = open(tmp_path / "mwal" / "wal", "rb").read()
+        records = [d for k, _, d in autofile.walk_frames(raw) if k == "record"]
+        assert records == [b"a=1", b"binary\nwith=newline"]
 
 
 class TestVoteSetBitsCatchup:

@@ -270,9 +270,12 @@ class TestTransitionsAndAutodump:
         assert set(ALARM_SEVERITY) == {
             "consensus_stall", "verify_stall", "round_churn", "peer_collapse",
             "loop_lag", "mempool_saturation", "ingress_shedding", "clock_drift",
+            "disk_fault", "disk_pressure",
         }
         assert ALARM_SEVERITY["consensus_stall"] == "critical"
         assert ALARM_SEVERITY["verify_stall"] == "critical"
+        assert ALARM_SEVERITY["disk_fault"] == "critical"
+        assert ALARM_SEVERITY["disk_pressure"] == "degraded"
 
     def test_autodump_fires_on_critical_transition_rate_bounded(self):
         node = _StubNode()
