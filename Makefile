@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench bench-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke disk-smoke scale-smoke bls-smoke bls-ext load-smoke forensics-smoke localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke disk-smoke scale-smoke bls-smoke bls-ext load-smoke forensics-smoke finality-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -57,6 +57,10 @@ load-smoke:      ## tx-ingress firehose vs a QoS-configured 4-val localnet: expl
 forensics-smoke: ## watchdog detects an injected partition live; a SIGKILLed node's debug bundle reconstructs its pre-crash span chains from the spool, offline
 	$(PY) networks/local/forensics_smoke.py --json
 	rm -rf build-forensics
+
+finality-smoke:  ## consensus-pipeline A/B: serial vs pipelined stage budgets on a 4-val localnet; pipelined commit-to-commit p50 must beat 100 ms and never regress past the serial arm
+	$(PY) networks/local/finality_smoke.py --json
+	rm -rf build-finality
 
 localnet:        ## 4-validator net as OS processes (no docker)
 	$(PY) -m tendermint_tpu.cli testnet --validators 4 --output ./build

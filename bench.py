@@ -463,6 +463,32 @@ def bench_load():
         return json.loads(run.stdout.strip().splitlines()[-1])
 
 
+def bench_finality():
+    """Consensus-pipeline finality as numbers: run the A/B finality rig
+    (networks/local/finality_smoke.py — the same 4-val localnet measured
+    serial then pipelined, stage budgets from node0's flight recorder)
+    and report `commit_to_commit_p50_ms`/`commit_to_commit_p90_ms`
+    (pipelined idle), `commit_to_commit_p50_ms_serial` (the A/B
+    baseline), `finality_under_load_p50_ms` (under a tools/loadgen.py
+    firehose) and both arms' per-stage budgets.  Raises on any checker
+    violation, a p50 >= 100 ms, or a p50 regression past the serial
+    arm — the smoke gates, not just the bench."""
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as tmp:
+        run = subprocess.run(
+            [sys.executable, os.path.join(repo, "networks", "local", "finality_smoke.py"),
+             "--build-dir", os.path.join(tmp, "build"), "--base-port", "31956", "--json"],
+            capture_output=True, text=True, timeout=420, cwd=repo,
+        )
+        if run.returncode != 0:
+            raise RuntimeError(f"finality smoke failed:\n{run.stdout}\n{run.stderr}")
+        return json.loads(run.stdout.strip().splitlines()[-1])
+
+
 def bench_forensics():
     """Crash forensics + self-diagnosis as numbers: run the forensics rig
     (networks/local/forensics_smoke.py — flight spool + watchdog armed on
@@ -707,6 +733,32 @@ def bench_bls():
         verify_ms_c = None
         verify_ms_pure = verify_ms
 
+    # cold hash-to-curve (no memo hit): the C map (expand_message_xmd +
+    # SVDW + clear cofactor, all in csrc/bls12_381.c) vs the pure
+    # reference map.  Acceptance: <= 1 ms with the C tier engaged.
+    from tendermint_tpu.crypto.bls import hash_to_curve
+
+    def measure_h2c(fn) -> float:
+        times = []
+        for i in range(7):
+            m = b"bench-h2c-cold-%d" % i
+            t0 = time.perf_counter()
+            fn(m)
+            times.append(time.perf_counter() - t0)
+        return min(times) * 1000
+
+    h2c_pure_ms = measure_h2c(
+        lambda m: hash_to_curve.hash_to_g2(m, scheme.DST_SIG)
+    )
+    if tier == "c":
+        h2c_ms = measure_h2c(lambda m: ctier.hash_to_g2_blob(m, scheme.DST_SIG))
+        # the C map silently not engaging is the ~15 ms pure number
+        assert h2c_ms <= 5.0, (
+            f"C hash-to-curve engaged but bls_h2c_ms={h2c_ms:.2f}"
+        )
+    else:
+        h2c_ms = h2c_pure_ms
+
     ed_pvs = sorted([MockPV() for _ in range(n_vals)], key=lambda pv: pv.address())
     _, ed_commit = full_commit(ed_pvs)
     bls_bytes = len(agg.encode())
@@ -732,6 +784,8 @@ def bench_bls():
         "ed25519_commit_bytes_100val": ed_bytes,
         "bls_commit_shrink_x": round(shrink, 1),
         "bls_fold_ms": round(fold_ms, 2),
+        "bls_h2c_ms": round(h2c_ms, 3),
+        "bls_h2c_ms_pure": round(h2c_pure_ms, 3),
     }
     if verify_ms_c is not None:
         out["bls_agg_verify_ms_c"] = round(verify_ms_c, 2)
@@ -887,6 +941,10 @@ def main() -> None:
         forensics = bench_forensics()
     except Exception as e:
         forensics = {"crash_bundle_completeness": -1.0, "error": str(e)[:300]}
+    try:
+        finality = bench_finality()
+    except Exception as e:
+        finality = {"commit_to_commit_p50_ms": -1.0, "error": str(e)[:300]}
     extras = {
         "commit_verify_100val_ms": bench_100val_commit(),
         "e2e_commits_per_sec_solo": asyncio.run(bench_e2e_commits()),
@@ -929,6 +987,12 @@ def main() -> None:
         "load_throttled": load.get("throttled"),
         "load_idle_commits_per_sec": load.get("idle_commits_per_sec"),
         "load_recovery_commits_per_sec": load.get("recovery_commits_per_sec"),
+        "commit_to_commit_p50_ms": finality.get("commit_to_commit_p50_ms", -1.0),
+        "commit_to_commit_p90_ms": finality.get("commit_to_commit_p90_ms", -1.0),
+        "commit_to_commit_p50_ms_serial": finality.get("commit_to_commit_p50_ms_serial"),
+        "finality_under_load_p50_ms": finality.get("finality_under_load_p50_ms", -1.0),
+        "finality_budget_pipelined": finality.get("budget_pipelined"),
+        "finality_budget_serial": finality.get("budget_serial"),
         "chaos_partition_recovery_ms": chaos.get("chaos_partition_recovery_ms", -1.0),
         "chaos_restart_recovery_ms": chaos.get("restart_recovery_ms"),
         "chaos_evidence_height": chaos.get("evidence_height"),

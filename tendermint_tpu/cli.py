@@ -380,6 +380,15 @@ def cmd_trace(args) -> int:
 
     snap = asyncio.run(fetch())
     events = snap.get("events", [])
+    if args.budget:
+        # per-stage latency budget: propose→prevote→precommit→
+        # commit(persist)→finalize(deliver)→next-propose + c2c percentiles
+        budget = tracing.stage_budget(events)
+        if args.json:
+            print(json.dumps({"budget": budget}))
+        else:
+            print(tracing.format_budget(budget))
+        return 0 if budget is not None else 1
     if args.json:
         print(json.dumps(snap))
     else:
@@ -933,6 +942,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--check",
         action="store_true",
         help="exit 1 unless every fully-recorded block has a complete propose→commit chain",
+    )
+    sp.add_argument(
+        "--budget",
+        action="store_true",
+        help="per-stage latency budget table (propose→…→finalize→next-propose)",
     )
     sp.set_defaults(fn=cmd_trace)
 

@@ -236,6 +236,31 @@ class ConsensusConfig:
     # is automatic — mixed or non-BLS sets keep per-vote commits — so the
     # knob exists only to A/B the wire format on an all-BLS net.
     bls_aggregate_commits: bool = True
+    # -- consensus pipeline (perf, ROADMAP item 3) ------------------------
+    # pipeline_delivery: once height H's block + seen commit are persisted
+    # (save_block + WAL ENDHEIGHT), ABCI delivery (begin/deliver_tx/end/
+    # commit + event publication) runs on a background task while the
+    # state machine advances to H+1 under a provisional state.  Everything
+    # that READS delivery output (the proposer building H+1's header with
+    # H's app_hash, prevote/precommit validation, the next finalize) joins
+    # the in-flight delivery first, so commit-to-commit time is bounded by
+    # the slowest stage instead of the serial sum.  Crash-safe: the
+    # persisted block + the handshake's store_height == state_height + 1
+    # replay lane already cover a death between persist and delivery.
+    # Off = the reference's strictly serial finalize (the A/B baseline).
+    pipeline_delivery: bool = True
+    # speculative_assembly: while H delivers, the next proposer pre-reaps
+    # the mempool and pre-builds H+1's block + part set, invalidated if
+    # the reap inputs change (mempool mutation, different last commit).
+    # Only consulted when this node is the H+1 round-0 proposer.
+    pipeline_speculative_assembly: bool = True
+    # commit_grace: skip_timeout_commit fires only when ALL precommits are
+    # in (state.go:1598 skipTimeoutCommit) — one dead validator forfeits
+    # the skip forever and every height eats the full timeout_commit.
+    # With +2/3 already committed, wait at most this long for stragglers
+    # before entering the next round.  0 keeps the reference behavior
+    # (full timeout_commit unless has_all).
+    commit_grace: float = 0.05
 
     def propose(self, round_: int) -> float:
         """config.go:815 — base + delta·round."""
@@ -462,6 +487,7 @@ class Config:
             ("timeout_prevote", self.consensus.timeout_prevote),
             ("timeout_precommit", self.consensus.timeout_precommit),
             ("timeout_commit", self.consensus.timeout_commit),
+            ("commit_grace", self.consensus.commit_grace),
         ):
             if v < 0:
                 raise ValueError(f"consensus.{name} can't be negative")

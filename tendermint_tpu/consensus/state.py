@@ -128,6 +128,19 @@ class ConsensusState(Service):
         # set only while finalizing from a peer-shipped AggregateCommit;
         # update_to_state consumes it as the next height's last-commit
         self._pending_agg_last_commit = None
+        # -- consensus pipeline (config.pipeline_delivery) -----------------
+        # In-flight ABCI delivery for the last committed height: a task
+        # resolving to ("ok", (new_state, retain_height)) or ("err", exc)
+        # — it never raises, so a dropped consume can't warn.  While it is
+        # set, sm_state is the PROVISIONAL next state (identical validator
+        # rotation, app_hash/results hash unknown); every reader of
+        # delivery output goes through _ensure_delivered() first, which
+        # joins the task and swaps the delivered state in.
+        self._delivery_task: Optional[asyncio.Task] = None
+        self._delivery_height = 0
+        # speculative proposal stash built on the delivery lane:
+        # (height, mempool_version, commit_sig_count, block, parts)
+        self._spec_proposal: Optional[tuple] = None
         self.replay_mode = False
         from ..libs import tracing
         from ..libs.metrics import ConsensusMetrics
@@ -244,6 +257,23 @@ class ConsensusState(Service):
                 # grace window, proceed; Service.stop's cancel pass covers
                 # the stragglers
                 await asyncio.wait({t}, timeout=2.0)
+        # Drain the pipelined delivery, not cancel it: the lane is
+        # mid-ABCI-commit holding the mempool lock and writing the state
+        # store — let it land so a restart finds store/state consistent
+        # (a crash here is exactly the handshake's store==state+1 lane).
+        if self._delivery_task is not None:
+            task = self._delivery_task
+            try:
+                await asyncio.wait_for(self._ensure_delivered(), timeout=5.0)
+            except asyncio.CancelledError:
+                if not task.cancelled():
+                    raise  # on_stop itself is being cancelled from outside
+                # The lane died cancelled anyway: store_height ==
+                # state_height + 1, the handshake's replay case — log and
+                # keep tearing down rather than abort node shutdown.
+                self.log.error("pipelined delivery cancelled during shutdown")
+            except Exception as e:
+                self.log.error("pipelined delivery failed during shutdown", err=repr(e))
         await self.timeout_ticker.stop()
         # A straggler receive task past the grace window may still be
         # mid-message; closing the WAL under it would lose the tail it is
@@ -523,6 +553,11 @@ class ConsensusState(Service):
         """state.go:877 — first height, or app hash changed last block."""
         if height == 1:
             return True
+        if self._delivery_task is not None:
+            # pipelined delivery in flight: the last app hash is not known
+            # yet — assume it changed (propose immediately rather than
+            # stall the pipeline waiting for txs)
+            return True
         last_meta = self.block_store.load_block_meta(height - 1)
         if last_meta is None:
             raise RuntimeError(f"need_proof_block: no block meta for height {height - 1}")
@@ -558,7 +593,12 @@ class ConsensusState(Service):
 
     async def default_decide_proposal(self, height: int, round_: int) -> None:
         """state.go:968."""
+        # the header we are about to build embeds the previous height's
+        # app_hash and results hash — join the pipelined delivery first
+        await self._ensure_delivered()
         rs = self.rs
+        if rs.height != height or rs.round != round_:
+            return  # the state machine moved on while we awaited delivery
         if rs.valid_block is not None:
             block, block_parts = rs.valid_block, rs.valid_block_parts
         else:
@@ -600,6 +640,18 @@ class ConsensusState(Service):
     def _create_proposal_block(self) -> Optional[Tuple[Block, PartSet]]:
         """state.go:1021."""
         rs = self.rs
+        spec, self._spec_proposal = self._spec_proposal, None
+        if (
+            spec is not None
+            and spec[0] == rs.height
+            and spec[1] == getattr(self.mempool, "version", None)
+            and spec[2] == self._last_commit_signed_count()
+        ):
+            # speculative assembly: the block pre-built on the delivery
+            # lane is still valid — same height, untouched mempool (the
+            # reap would return the same set), same last-commit signers
+            self.recorder.record("proposal.speculative_hit", height=rs.height)
+            return spec[3], spec[4]
         if rs.height == 1:
             commit = Commit(0, 0, BlockID(), [])
         elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
@@ -615,6 +667,18 @@ class ConsensusState(Service):
         )
         parts = block.make_part_set(BLOCK_PART_SIZE_BYTES)
         return block, parts
+
+    def _last_commit_signed_count(self) -> int:
+        """Signer count of rs.last_commit — the speculative-proposal
+        invalidation key for the embedded commit: votes are only ever
+        ADDED, so an equal count means the identical signer set."""
+        lc = self.rs.last_commit
+        if lc is None:
+            return -1
+        try:
+            return lc.bit_array().count()
+        except Exception:
+            return -1
 
     def _maybe_fold_commit(self, commit, val_set):
         """Fold a +2/3 commit into ONE aggregate BLS signature + signer
@@ -663,6 +727,10 @@ class ConsensusState(Service):
 
     async def default_do_prevote(self, height: int, round_: int) -> None:
         """state.go:1093."""
+        # validate_block below compares the header's app_hash /
+        # results hash / params against sm_state — join the pipelined
+        # delivery so those fields are the committed ones
+        await self._ensure_delivered()
         rs = self.rs
         if rs.locked_block is not None:
             await self._sign_add_vote(PREVOTE_TYPE, rs.locked_block.hash(), rs.locked_block_parts.header())
@@ -715,6 +783,12 @@ class ConsensusState(Service):
         ):
             return
         self.log.debug("enterPrecommit", height=height, round=round_)
+
+        # the lock path validates the proposal block against sm_state;
+        # normally a no-op (do_prevote already joined), but a node pulled
+        # straight to precommit by peer +2/3 must not validate against the
+        # provisional state
+        await self._ensure_delivered()
 
         try:
             prevotes = rs.votes.prevotes(round_)
@@ -929,6 +1003,9 @@ class ConsensusState(Service):
         """The source-independent tail of finalize_commit: `block_id` and
         the lazily-built seen commit come from either the precommit vote
         set (normal path) or a verified AggregateCommit (catchup path)."""
+        # one delivery in flight at a time: H's apply must complete (and
+        # its state swap in) before H+1's persist/apply can start
+        await self._ensure_delivered()
         rs = self.rs
         block, block_parts = rs.proposal_block, rs.proposal_block_parts
         if not block_parts.has_header(block_id.parts_header):
@@ -959,23 +1036,137 @@ class ConsensusState(Service):
         fail_point("finalize-walled-endheight")
 
         state_copy = self.sm_state.copy()
-        new_state, retain_height = await self.block_exec.apply_block(
-            state_copy, BlockID(block.hash(), block_parts.header()), block
+        bid = BlockID(block.hash(), block_parts.header())
+        self.recorder.record("deliver.start", height=block.height)
+
+        if not self.config.pipeline_delivery or self.replay_mode:
+            # serial path (A/B off switch + WAL replay): the reference's
+            # strictly sequential finalize
+            new_state, retain_height = await self.block_exec.apply_block(
+                state_copy, bid, block
+            )
+            self.recorder.record("deliver.end", height=block.height)
+            fail_point("finalize-applied")
+            self._prune_if_requested(retain_height)
+            self.update_to_state(new_state)
+            self.schedule_round0()
+            return
+
+        # pipelined path: H is durable (block + seen commit saved, WAL
+        # ENDHEIGHT written) — ship ABCI delivery onto its own lane and
+        # advance the round machinery to H+1 under the provisional state.
+        # A crash before the lane lands leaves store_height ==
+        # state_height + 1, exactly the handshake's existing replay case.
+        from ..state.execution import provisional_next_state
+
+        provisional = provisional_next_state(state_copy, bid, block)
+        self._delivery_height = block.height
+        self._delivery_task = self.spawn(
+            self._deliver_block(state_copy, bid, block), "deliver"
         )
-        fail_point("finalize-applied")
-
-        if retain_height > 0:
-            try:
-                base = self.block_store.base()
-                if retain_height > base:
-                    pruned = self.block_store.prune_blocks(retain_height)
-                    self.state_prune(retain_height)
-                    self.log.info("pruned blocks", pruned=pruned, retain_height=retain_height)
-            except Exception as e:
-                self.log.error("failed to prune blocks", err=str(e))
-
-        self.update_to_state(new_state)
+        self.update_to_state(provisional)
         self.schedule_round0()
+
+    async def _deliver_block(self, state_copy, block_id, block) -> tuple:
+        """The pipelined delivery lane: apply_block (begin/deliver_tx/
+        end/commit + state save + event publication) off the receive
+        routine.  Resolves to a ("ok"|"err", payload) pair instead of
+        raising so an unconsumed task never logs a phantom crash; the
+        _ensure_delivered() awaiter re-raises errors into the receive
+        routine where the storage-fault classifier lives."""
+        try:
+            new_state, retain_height = await self.block_exec.apply_block(
+                state_copy, block_id, block
+            )
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:
+            return ("err", e)
+        self.recorder.record("deliver.end", height=block.height)
+        fail_point("finalize-applied")
+        if self.config.pipeline_speculative_assembly:
+            self._speculate_proposal(new_state)
+        return ("ok", (new_state, retain_height))
+
+    async def _ensure_delivered(self) -> None:
+        """Join the in-flight pipelined delivery, if any.  Every reader
+        of delivery output — the proposer embedding the committed
+        app_hash into the next header, prevote/precommit validation, the
+        next finalize — calls this first.  Swaps the provisional state
+        for the delivered one: the validator rotation is identical by
+        construction (provisional_next_state), delivery fills in
+        app_hash, last_results_hash and the validator/param updates."""
+        task = self._delivery_task
+        if task is None:
+            return
+        # shield: when an awaiter parked here is cancelled (on_stop
+        # cancelling the receive routine), asyncio cancels the awaiter's
+        # _fut_waiter — which without the shield IS the delivery task.
+        # The lane may be mid-ABCI-commit; the canceller's unwind must
+        # not kill it.  The awaiter still sees CancelledError and
+        # unwinds; the lane keeps running for the shutdown drain.
+        status, payload = await asyncio.shield(task)
+        if self._delivery_task is not task:
+            return  # a concurrent awaiter (shutdown drain) consumed it
+        self._delivery_task = None
+        if status == "err":
+            self._spec_proposal = None
+            raise payload
+        new_state, retain_height = payload
+        self.sm_state = new_state
+        self._prune_if_requested(retain_height)
+
+    def _speculate_proposal(self, state) -> None:
+        """Speculative block assembly (runs on the delivery lane, after
+        apply): if this node proposes the next height's round 0, pre-reap
+        the mempool and pre-build the block + part set now, while the
+        net is still exchanging votes.  _create_proposal_block consumes
+        the stash only if the reap inputs are provably unchanged
+        (mempool version + last-commit signer count)."""
+        try:
+            rs = self.rs
+            if (
+                self.priv_validator is None
+                or rs.height != state.last_block_height + 1
+                or rs.round != 0
+                or rs.proposal is not None
+                or rs.last_commit is None
+                or not rs.last_commit.has_two_thirds_majority()
+            ):
+                return
+            addr = self.priv_validator.get_pub_key().address()
+            if rs.validators.get_proposer().address != addr:
+                return
+            commit = self._maybe_fold_commit(
+                rs.last_commit.make_commit(), state.last_validators
+            )
+            block = self.block_exec.create_proposal_block(rs.height, state, commit, addr)
+            parts = block.make_part_set(BLOCK_PART_SIZE_BYTES)
+            self._spec_proposal = (
+                rs.height,
+                getattr(self.mempool, "version", None),
+                self._last_commit_signed_count(),
+                block,
+                parts,
+            )
+            self.recorder.record(
+                "proposal.speculative", height=rs.height, txs=len(block.txs)
+            )
+        except Exception as e:  # speculation must never break delivery
+            self._spec_proposal = None
+            self.log.debug("speculative assembly failed", err=str(e))
+
+    def _prune_if_requested(self, retain_height: int) -> None:
+        if retain_height <= 0:
+            return
+        try:
+            base = self.block_store.base()
+            if retain_height > base:
+                pruned = self.block_store.prune_blocks(retain_height)
+                self.state_prune(retain_height)
+                self.log.info("pruned blocks", pruned=pruned, retain_height=retain_height)
+        except Exception as e:
+            self.log.error("failed to prune blocks", err=str(e))
 
     def state_prune(self, retain_height: int) -> None:
         self.block_exec.state_store.prune_states(retain_height)
@@ -1394,6 +1585,21 @@ class ConsensusState(Service):
     def schedule_round0(self) -> None:
         """state.go:466 — enter_new_round(height, 0) at start_time."""
         sleep = self.rs.start_time - self.clock.monotonic()
+        lc = self.rs.last_commit
+        if (
+            self.config.skip_timeout_commit
+            and self.config.commit_grace > 0
+            and sleep > self.config.commit_grace
+            and lc is not None
+            and not lc.has_all()
+        ):
+            # all-precommits grace: skip_timeout_commit only fires on
+            # has_all() (state.go:1598) — one slow or dead validator would
+            # forfeit the skip forever and every height would eat the full
+            # timeout_commit.  With +2/3 already in hand, wait at most
+            # commit_grace for the stragglers; the has_all short-circuits
+            # in _add_vote still fire the instant the last one lands.
+            sleep = self.config.commit_grace
         self._schedule_timeout(sleep, self.rs.height, 0, RoundStep.NEW_HEIGHT)
 
     def _schedule_timeout(self, duration: float, height: int, round_: int, step: int) -> None:

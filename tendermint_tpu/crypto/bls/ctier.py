@@ -125,6 +125,14 @@ def _load_lib() -> Optional[ctypes.CDLL]:
             cdll.bls381_pairing_check.restype = ctypes.c_int
             cdll.bls381_pairing_product.argtypes = [u8, u8, ctypes.c_uint64, buf]
             cdll.bls381_pairing_product.restype = ctypes.c_int
+            cdll.bls381_expand_xmd.argtypes = [
+                u8, ctypes.c_uint64, u8, ctypes.c_uint64, buf, ctypes.c_uint64,
+            ]
+            cdll.bls381_expand_xmd.restype = ctypes.c_int
+            cdll.bls381_hash_to_g2.argtypes = [
+                u8, ctypes.c_uint64, u8, ctypes.c_uint64, buf,
+            ]
+            cdll.bls381_hash_to_g2.restype = ctypes.c_int
             # init derives every constant and self-checks the transcribed
             # prime against p == ((x-1)^2/3)·r + x; a failed check refuses
             # the tier rather than corrupting consensus crypto
@@ -338,6 +346,43 @@ def g2_mul(blob, k: int):
     if rc < 0:
         raise ValueError("bad G2 blob")
     return out.raw if rc == 1 else INF
+
+
+# -- hash-to-curve ----------------------------------------------------------
+# RFC 9380 SVDW random-oracle hash, entirely in C (expand_message_xmd,
+# hash_to_field, map, clear cofactor).  Output blobs are BIT-IDENTICAL to
+# hash_to_curve.hash_to_g2 — every root/sign choice in the C map replicates
+# the pure functions, and the differential suite pins it.
+
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    """RFC 9380 §5.3.1 expand_message_xmd/SHA-256, C path."""
+    lib = _lib_or_raise()
+    if len_in_bytes == 0:
+        # the C entry writes nothing for a zero-length request
+        ell_probe = lib.bls381_expand_xmd(b"", 0, bytes(dst), len(dst), b"", 0)
+        if ell_probe != 1:
+            raise ValueError("expand_message_xmd failed")
+        return b""
+    out = ctypes.create_string_buffer(len_in_bytes)
+    rc = lib.bls381_expand_xmd(
+        bytes(msg), len(msg), bytes(dst), len(dst), out, len_in_bytes
+    )
+    if rc != 1:
+        raise ValueError("len_in_bytes too large for xmd")
+    return out.raw
+
+
+def hash_to_g2_blob(msg: bytes, dst: bytes):
+    """hash_to_g2(msg, dst) -> affine blob (or INF), C path end to end."""
+    lib = _lib_or_raise()
+    out = ctypes.create_string_buffer(192)
+    rc = lib.bls381_hash_to_g2(bytes(msg), len(msg), bytes(dst), len(dst), out)
+    if rc == 1:
+        return out.raw
+    if rc == 0:
+        return INF
+    raise ValueError("hash_to_g2 failed")
 
 
 # -- pairing ----------------------------------------------------------------

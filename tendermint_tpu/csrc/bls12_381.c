@@ -223,9 +223,10 @@ static void fp_to_bytes(uint8_t *out, const fp *a) {
   }
 }
 
-/* MSB-first square-and-multiply over a 6-limb exponent (canonical) */
+/* MSB-first 4-bit-windowed exponentiation over a 6-limb exponent
+ * (canonical).  Nibbles never straddle limbs (4 | 64), so the window
+ * extraction is one shift. */
 static void fp_pow(fp *o, const fp *a, const uint64_t e[6]) {
-  fp res = FP_ONE, base = *a;
   int top = -1;
   for (int i = 5; i >= 0 && top < 0; i--)
     if (e[i]) {
@@ -233,10 +234,19 @@ static void fp_pow(fp *o, const fp *a, const uint64_t e[6]) {
         if ((e[i] >> b) & 1) { top = i * 64 + b; break; }
     }
   if (top < 0) { *o = FP_ONE; return; }
-  for (int i = top; i >= 0; i--) {
-    if (i != top) fp_sq(&res, &res);
-    else res = base;
-    if (i != top && ((e[i / 64] >> (i % 64)) & 1)) fp_mul(&res, &res, &base);
+  fp tbl[16];
+  tbl[0] = FP_ONE;
+  tbl[1] = *a;
+  for (int i = 2; i < 16; i++) fp_mul(&tbl[i], &tbl[i - 1], a);
+  int nt = top / 4;
+  fp res = tbl[(e[(4 * nt) / 64] >> ((4 * nt) % 64)) & 0xF];
+  for (int i = nt - 1; i >= 0; i--) {
+    fp_sq(&res, &res);
+    fp_sq(&res, &res);
+    fp_sq(&res, &res);
+    fp_sq(&res, &res);
+    uint64_t nib = (e[(4 * i) / 64] >> ((4 * i) % 64)) & 0xF;
+    if (nib) fp_mul(&res, &res, &tbl[nib]);
   }
   *o = res;
 }
@@ -851,6 +861,11 @@ static void g2_mul_bytes(g2p *o, const g2p *p, const uint8_t *sc, int len) {
 
 static int g2_affine(g2a *o, const g2p *p) {
   if (f2_is_zero(&p->z)) return 0;
+  if (fp_eq(&p->z.c0, &FP_ONE) && fp_is_zero(&p->z.c1)) {
+    o->x = p->x;                              /* z == 1: skip the inversion */
+    o->y = p->y;
+    return 1;
+  }
   fp2 zi, z2;
   f2_inv(&zi, &p->z);
   f2_sq(&z2, &zi);
@@ -1186,6 +1201,8 @@ static int derive_order_and_check(void) {
   return limbs_cmp(prod, P_L) == 0;
 }
 
+static int derive_svdw(void);                /* hash-to-curve constants */
+
 int bls381_ready(void) {
   if (g_ready) return 1;
   if (!derive_order_and_check()) return 0;
@@ -1255,6 +1272,8 @@ int bls381_ready(void) {
   f2_inv(&PSI_CX, &w);
   f2_pow(&w, &xi, e2);
   f2_inv(&PSI_CY, &w);
+  /* SvdW hash-to-curve constants (Z, c1..c4), derived not transcribed */
+  if (!derive_svdw()) return 0;
   g_ready = 1;
   return 1;
 }
@@ -1417,6 +1436,343 @@ done:
   return rc;
 }
 
+/* ------------------------------------------------------------- SHA-256 -- */
+/* Needed by expand_message_xmd below; FIPS 180-4, no lookup beyond K. */
+
+static const uint32_t SHA_K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+};
+
+typedef struct {
+  uint32_t h[8];
+  uint64_t nbytes;
+  uint8_t buf[64];
+  int fill;
+} sha256_ctx;
+
+static uint32_t rotr32(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+static void sha256_block(uint32_t h[8], const uint8_t *p) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+           ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr32(w[i - 15], 7) ^ rotr32(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr32(w[i - 2], 17) ^ rotr32(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+  uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t s1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = hh + s1 + ch + SHA_K[i] + w[i];
+    uint32_t s0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22);
+    uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = s0 + mj;
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+static void sha256_init(sha256_ctx *c) {
+  static const uint32_t iv[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                                 0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  memcpy(c->h, iv, sizeof(iv));
+  c->nbytes = 0;
+  c->fill = 0;
+}
+
+static void sha256_update(sha256_ctx *c, const uint8_t *d, uint64_t n) {
+  c->nbytes += n;
+  if (c->fill) {
+    while (n && c->fill < 64) { c->buf[c->fill++] = *d++; n--; }
+    if (c->fill == 64) { sha256_block(c->h, c->buf); c->fill = 0; }
+  }
+  while (n >= 64) { sha256_block(c->h, d); d += 64; n -= 64; }
+  while (n) { c->buf[c->fill++] = *d++; n--; }
+}
+
+static void sha256_final(sha256_ctx *c, uint8_t out[32]) {
+  uint64_t bits = c->nbytes * 8;
+  uint8_t pad = 0x80, zero = 0;
+  sha256_update(c, &pad, 1);
+  while (c->fill != 56) sha256_update(c, &zero, 1);
+  uint8_t len[8];
+  for (int i = 0; i < 8; i++) len[i] = (uint8_t)(bits >> (8 * (7 - i)));
+  sha256_update(c, len, 8);
+  for (int i = 0; i < 8; i++) {
+    uint32_t v = c->h[i];
+    out[4 * i] = (uint8_t)(v >> 24);
+    out[4 * i + 1] = (uint8_t)(v >> 16);
+    out[4 * i + 2] = (uint8_t)(v >> 8);
+    out[4 * i + 3] = (uint8_t)v;
+  }
+}
+
+/* ------------------------------------------------- hash-to-curve (G2) -- */
+/* RFC 9380 machinery mirroring crypto/bls/hash_to_curve.py exactly:
+ * expand_message_xmd/SHA-256, hash_to_field for Fp2 (L = 64), the
+ * Shallue–van de Woestijne map with Z and c1..c4 DERIVED at init by the
+ * RFC's own find_z_svdw spiral (same candidate order as the pure tier, so
+ * the same Z falls out), and Budroni–Pintore cofactor clearing.  Output
+ * affine coordinates are unique, and every sign/root choice below (fp2
+ * sqrt candidate order, sgn0 fixes for c3 and y) replicates the pure
+ * functions, so blobs are BIT-IDENTICAL to the reference tier — which the
+ * C-vs-pure differential suite pins. */
+
+static fp2 SVDW_Z, SVDW_C1, SVDW_C2, SVDW_C3, SVDW_C4;
+
+/* RFC 9380 §5.3.1 with SHA-256.  1 ok / 0 unsupported length. */
+static int expand_xmd(const uint8_t *msg, uint64_t msg_len, const uint8_t *dst,
+                      uint64_t dst_len, uint8_t *out, uint64_t len_in_bytes) {
+  uint8_t dst_buf[49];
+  if (dst_len > 255) {
+    /* dst = "H2C-OVERSIZE-DST-" || sha256(dst) */
+    memcpy(dst_buf, "H2C-OVERSIZE-DST-", 17);
+    sha256_ctx hc;
+    sha256_init(&hc);
+    sha256_update(&hc, dst, dst_len);
+    sha256_final(&hc, dst_buf + 17);
+    dst = dst_buf;
+    dst_len = 49;
+  }
+  uint64_t ell = (len_in_bytes + 31) / 32;
+  if (ell > 255) return 0;
+  if (len_in_bytes == 0) return 1;
+  uint8_t dl = (uint8_t)dst_len;
+  uint8_t z_pad[64];
+  memset(z_pad, 0, sizeof(z_pad));
+  uint8_t lib[3];
+  lib[0] = (uint8_t)(len_in_bytes >> 8);
+  lib[1] = (uint8_t)len_in_bytes;
+  lib[2] = 0;
+  uint8_t b0[32], bi[32];
+  sha256_ctx c;
+  sha256_init(&c);
+  sha256_update(&c, z_pad, 64);
+  sha256_update(&c, msg, msg_len);
+  sha256_update(&c, lib, 3);
+  sha256_update(&c, dst, dst_len);
+  sha256_update(&c, &dl, 1);
+  sha256_final(&c, b0);
+  uint8_t one = 1;
+  sha256_init(&c);
+  sha256_update(&c, b0, 32);
+  sha256_update(&c, &one, 1);
+  sha256_update(&c, dst, dst_len);
+  sha256_update(&c, &dl, 1);
+  sha256_final(&c, bi);
+  uint64_t off = 0;
+  for (uint64_t i = 1;; i++) {
+    uint64_t take = len_in_bytes - off < 32 ? len_in_bytes - off : 32;
+    memcpy(out + off, bi, take);
+    off += take;
+    if (i >= ell) break;
+    uint8_t x[32];
+    for (int j = 0; j < 32; j++) x[j] = b0[j] ^ bi[j];
+    uint8_t idx = (uint8_t)(i + 1);
+    sha256_init(&c);
+    sha256_update(&c, x, 32);
+    sha256_update(&c, &idx, 1);
+    sha256_update(&c, dst, dst_len);
+    sha256_update(&c, &dl, 1);
+    sha256_final(&c, bi);
+  }
+  return 1;
+}
+
+/* 64 big-endian bytes -> Fp element mod p (Montgomery form): canonical
+ * Horner over bytes with modular doublings, then one to_mont. */
+static void fp_from_64be_mod(fp *o, const uint8_t *in) {
+  fp acc, d;
+  memset(&acc, 0, sizeof(acc));
+  memset(&d, 0, sizeof(d));
+  for (int i = 0; i < 64; i++) {
+    for (int b = 0; b < 8; b++) fp_add(&acc, &acc, &acc);
+    d.l[0] = in[i];
+    fp_add(&acc, &acc, &d);
+  }
+  fp_to_mont(o, &acc);
+}
+
+/* Euler criterion via the norm map (fields.f2_is_square): a square iff
+ * N(a) = a0² + a1² is a square in Fp, with 0 counting as square. */
+static int f2_is_square_euler(const fp2 *a) {
+  if (f2_is_zero(a)) return 1;
+  fp n, t;
+  fp_sq(&n, &a->c0);
+  fp_sq(&t, &a->c1);
+  fp_add(&n, &n, &t);
+  fp_pow(&t, &n, HALF_L);
+  return fp_eq(&t, &FP_ONE);
+}
+
+/* RFC 9380 §4.1 sgn0 for m = 2: parity of the first non-zero coord. */
+static int f2_sgn0_(const fp2 *a) {
+  fp c;
+  fp_from_mont(&c, &a->c0);
+  uint64_t v = 0;
+  for (int i = 0; i < 6; i++) v |= c.l[i];
+  if (v) return (int)(c.l[0] & 1);
+  fp_from_mont(&c, &a->c1);
+  return (int)(c.l[0] & 1);
+}
+
+/* g(x) = x³ + B on the twist (A = 0) */
+static void svdw_g(fp2 *o, const fp2 *x) {
+  fp2 t;
+  f2_sq(&t, x);
+  f2_mul(&t, &t, x);
+  f2_add(o, &t, &B2_M);
+}
+
+/* find_z_svdw (RFC 9380 §H.1) + the c1..c4 derivation — same candidate
+ * spiral and criteria order as hash_to_curve._find_z_svdw, so both tiers
+ * settle on the identical Z.  1 ok / 0 derivation failed (refuses tier). */
+static int derive_svdw(void) {
+  int found = 0;
+  for (uint64_t k = 1; k < 4096 && !found; k++) {
+    fp km, t;
+    memset(&t, 0, sizeof(t));
+    t.l[0] = k;
+    fp_to_mont(&km, &t);
+    for (int ci = 0; ci < 6 && !found; ci++) {
+      fp2 cand;
+      memset(&cand, 0, sizeof(cand));
+      int shape = ci >> 1;                   /* 0:(k,0) 1:(0,k) 2:(k,k) */
+      if (shape == 0) cand.c0 = km;
+      else if (shape == 1) cand.c1 = km;
+      else { cand.c0 = km; cand.c1 = km; }
+      if (ci & 1) f2_neg(&cand, &cand);
+      fp2 gz, h, four_gz, ratio, u;
+      svdw_g(&gz, &cand);
+      if (f2_is_zero(&gz)) continue;
+      f2_sq(&h, &cand);
+      f2_add(&u, &h, &h);
+      f2_add(&h, &u, &h);                    /* 3Z² (A = 0) */
+      if (f2_is_zero(&h)) continue;
+      f2_add(&four_gz, &gz, &gz);
+      f2_add(&four_gz, &four_gz, &four_gz);
+      f2_inv(&ratio, &four_gz);
+      f2_mul(&ratio, &h, &ratio);
+      f2_neg(&ratio, &ratio);                /* -(3Z²+4A)/(4g(Z)) */
+      if (f2_is_zero(&ratio) || !f2_is_square_euler(&ratio)) continue;
+      fp2 nz2, gnz2;
+      f2_mul_fp(&nz2, &cand, &INV2_M);
+      f2_neg(&nz2, &nz2);                    /* -Z/2 */
+      svdw_g(&gnz2, &nz2);
+      if (!(f2_is_square_euler(&gz) || f2_is_square_euler(&gnz2))) continue;
+      SVDW_Z = cand;
+      found = 1;
+    }
+  }
+  if (!found) return 0;
+  fp2 gz, h3, t;
+  svdw_g(&gz, &SVDW_Z);
+  SVDW_C1 = gz;
+  f2_mul_fp(&SVDW_C2, &SVDW_Z, &INV2_M);
+  f2_neg(&SVDW_C2, &SVDW_C2);                /* -Z/2 */
+  f2_sq(&h3, &SVDW_Z);
+  f2_add(&t, &h3, &h3);
+  f2_add(&h3, &t, &h3);                      /* 3Z² */
+  f2_mul(&t, &gz, &h3);
+  f2_neg(&t, &t);
+  if (!f2_sqrt(&SVDW_C3, &t)) return 0;      /* sqrt(-g(Z)·3Z²) */
+  if (f2_sgn0_(&SVDW_C3) == 1) f2_neg(&SVDW_C3, &SVDW_C3);
+  f2_add(&t, &gz, &gz);
+  f2_add(&t, &t, &t);                        /* 4g(Z) */
+  fp2 h3i;
+  f2_inv(&h3i, &h3);
+  f2_mul(&SVDW_C4, &t, &h3i);
+  f2_neg(&SVDW_C4, &SVDW_C4);                /* -4g(Z)/(3Z²) */
+  return 1;
+}
+
+/* RFC 9380 §6.6.1 straight-line SvdW map -> E'(Fp2) affine (not yet in
+ * the r-subgroup); mirrors map_to_curve_svdw including the sgn0 fix. */
+static void map_svdw(g2a *o, const fp2 *u) {
+  fp2 one, tv1, tv2, tv3, tv4, x1, x2, x3, gx, x, y, t;
+  one.c0 = FP_ONE;
+  memset(&one.c1, 0, sizeof(fp));
+  f2_sq(&tv1, u);
+  f2_mul(&tv1, &tv1, &SVDW_C1);
+  f2_add(&tv2, &one, &tv1);
+  f2_sub(&tv1, &one, &tv1);
+  f2_mul(&tv3, &tv1, &tv2);
+  if (!f2_is_zero(&tv3)) f2_inv(&tv3, &tv3);  /* inv0 */
+  f2_mul(&tv4, u, &tv1);
+  f2_mul(&tv4, &tv4, &tv3);
+  f2_mul(&tv4, &tv4, &SVDW_C3);
+  f2_sub(&x1, &SVDW_C2, &tv4);
+  fp2 gx1, gx2;
+  svdw_g(&gx1, &x1);
+  int e1 = f2_is_square_euler(&gx1);
+  f2_add(&x2, &SVDW_C2, &tv4);
+  int e2 = 0;
+  if (!e1) {                 /* e2 = is_square(g(x2)) && !e1: skip when e1 */
+    svdw_g(&gx2, &x2);
+    e2 = f2_is_square_euler(&gx2);
+  }
+  f2_sq(&t, &tv2);
+  f2_mul(&t, &t, &tv3);
+  f2_sq(&t, &t);
+  f2_mul(&x3, &t, &SVDW_C4);
+  f2_add(&x3, &x3, &SVDW_Z);
+  if (e1) { x = x1; gx = gx1; }
+  else if (e2) { x = x2; gx = gx2; }
+  else { x = x3; svdw_g(&gx, &x3); }
+  f2_sqrt(&y, &gx);         /* square by SvdW selection; same root as pure */
+  if (f2_sgn0_(u) != f2_sgn0_(&y)) f2_neg(&y, &y);
+  o->x = x;
+  o->y = y;
+}
+
+/* [x]P for the (negative) curve parameter: -[|x|]P */
+static void g2_mul_x(g2p *o, const g2p *p) {
+  uint8_t xb[8];
+  for (int i = 0; i < 8; i++) xb[i] = (uint8_t)(ABS_X >> (8 * (7 - i)));
+  g2_mul_bytes(o, p, xb, 8);
+  g2_neg(o, o);
+}
+
+static void g2_psi_j(g2p *o, const g2p *p) {
+  g2a a;
+  if (!g2_affine(&a, p)) { memset(o, 0, sizeof(*o)); return; }
+  g2_psi_affine(o, &a);
+}
+
+/* Budroni–Pintore: [x²-x-1]P + [x-1]ψ(P) + ψ²([2]P), as
+ * curve.g2_clear_cofactor */
+static void g2_clear_cofactor_j(g2p *o, const g2p *p) {
+  g2p t1, t2, t3, out, ps, np, d;
+  g2_neg(&np, p);
+  g2_mul_x(&t1, p);                          /* [x]P */
+  g2_add(&t2, &t1, &np);                     /* [x-1]P */
+  g2_mul_x(&t3, &t2);                        /* [x²-x]P */
+  g2_add(&out, &t3, &np);                    /* [x²-x-1]P */
+  g2_psi_j(&ps, &t2);
+  g2_add(&out, &out, &ps);                   /* + [x-1]ψ(P) */
+  g2_dbl(&d, p);
+  g2_psi_j(&ps, &d);
+  g2_psi_j(&ps, &ps);
+  g2_add(&out, &out, &ps);                   /* + ψ²([2]P) */
+  *o = out;
+}
+
 /* 1 when the product equals 1 (THE verification equation), 0 when not,
  * -1 on bad input */
 int bls381_pairing_check(const uint8_t *g1s, const uint8_t *g2s, uint64_t n) {
@@ -1440,4 +1796,41 @@ done:
   free(ps);
   free(qs);
   return rc;
+}
+
+/* RFC 9380 expand_message_xmd/SHA-256; 1 ok / 0 unsupported length */
+int bls381_expand_xmd(const uint8_t *msg, uint64_t msg_len, const uint8_t *dst,
+                      uint64_t dst_len, uint8_t *out, uint64_t len_in_bytes) {
+  return expand_xmd(msg, msg_len, dst, dst_len, out, len_in_bytes);
+}
+
+/* random-oracle hash to the G2 subgroup -> affine blob; 1 finite (out
+ * written) / 0 infinity.  Bit-identical to hash_to_curve.hash_to_g2. */
+int bls381_hash_to_g2(const uint8_t *msg, uint64_t msg_len, const uint8_t *dst,
+                      uint64_t dst_len, uint8_t *out) {
+  uint8_t uni[256];                           /* count=2, m=2, L=64 */
+  if (!expand_xmd(msg, msg_len, dst, dst_len, uni, 256)) return -1;
+  fp2 u0, u1;
+  fp_from_64be_mod(&u0.c0, uni);
+  fp_from_64be_mod(&u0.c1, uni + 64);
+  fp_from_64be_mod(&u1.c0, uni + 128);
+  fp_from_64be_mod(&u1.c1, uni + 192);
+  g2a q0, q1;
+  map_svdw(&q0, &u0);
+  map_svdw(&q1, &u1);
+  g2p a, b, s, cleared;
+  a.x = q0.x;
+  a.y = q0.y;
+  a.z.c0 = FP_ONE;
+  memset(&a.z.c1, 0, sizeof(fp));
+  b.x = q1.x;
+  b.y = q1.y;
+  b.z.c0 = FP_ONE;
+  memset(&b.z.c1, 0, sizeof(fp));
+  g2_add(&s, &a, &b);
+  g2_clear_cofactor_j(&cleared, &s);
+  g2a r;
+  if (!g2_affine(&r, &cleared)) return 0;
+  g2a_to_blob(out, &r);
+  return 1;
 }

@@ -14,11 +14,13 @@ import os
 import sys
 
 _call_index = -1
+_label_counts: dict = {}
 
 
 def reset() -> None:
     global _call_index
     _call_index = -1
+    _label_counts.clear()
 
 
 def fail() -> None:
@@ -34,6 +36,21 @@ def fail() -> None:
 
 
 def fail_point(label: str = "") -> None:
-    """Named fail point; label is informational (call order defines the
-    index, as in the reference)."""
+    """Named fail point; call order defines the ``FAIL_TEST_INDEX`` index
+    (as in the reference).  ``FAIL_TEST_LABEL="<label>:<n>"`` additionally
+    exits hard at the n-th execution (1-based; default 1) of that SPECIFIC
+    site, so a rig can pin a crash to one spot — e.g. between the WAL
+    ENDHEIGHT marker and the pipelined ABCI delivery landing — regardless
+    of how many unrelated fail points run first."""
+    env = os.environ.get("FAIL_TEST_LABEL")
+    if env and label:
+        want, _, nth = env.partition(":")
+        if label == want:
+            _label_counts[label] = _label_counts.get(label, 0) + 1
+            if _label_counts[label] == int(nth or 1):
+                sys.stderr.write(
+                    f"*** fail-point {label!r} #{_label_counts[label]} tripped — exiting\n"
+                )
+                sys.stderr.flush()
+                os._exit(1)
     fail()

@@ -342,6 +342,98 @@ def block_breakdown(events: List[dict]) -> Optional[dict]:
     }
 
 
+#: Stage names of the consensus latency budget, in pipeline order.
+#: commit_persist = enter Commit → delivery handoff (block save + WAL
+#: ENDHEIGHT); finalize = the ABCI delivery span (begin/deliver_tx/end/
+#: commit + events), which overlaps the next height when the pipeline is
+#: on; next_propose = Commit(H) → Propose(H+1), the height turnaround.
+BUDGET_STAGES = (
+    "propose", "prevote", "precommit", "commit_persist", "finalize", "next_propose",
+)
+
+
+def _pctl(xs: List[float], q: float) -> float:
+    """Nearest-rank percentile (sorted copy; 0 on empty)."""
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+
+def stage_budget(events: List[dict]) -> Optional[dict]:
+    """Decompose committed heights into a per-stage latency budget from
+    flight-recorder spans: propose→prevote→precommit→commit(persist)→
+    finalize(deliver)→next-propose, plus commit-to-commit percentiles —
+    the `trace budget` report (per-stage methodology after
+    arXiv:2302.00418 §5).  Uses the `step` chains for the vote stages and
+    the `deliver.start`/`deliver.end` span for ABCI delivery, so the same
+    report attributes time in both serial and pipelined modes.  None when
+    fewer than 2 complete consecutive chains exist."""
+    chains = step_chains(events)
+    heights = complete_heights(chains)
+    deliver_start: dict = {}
+    deliver_end: dict = {}
+    for ev in events:
+        k = ev.get("kind")
+        if k == "deliver.start":
+            deliver_start.setdefault(ev["height"], ev["t_ns"])
+        elif k == "deliver.end":
+            deliver_end[ev["height"]] = ev["t_ns"]
+    stages: dict = {name: [] for name in BUDGET_STAGES}
+    c2c: List[float] = []
+    for h in heights:
+        steps = chains[h]
+        stages["propose"].append((steps["Prevote"] - steps["Propose"]) / 1e6)
+        stages["prevote"].append((steps["Precommit"] - steps["Prevote"]) / 1e6)
+        stages["precommit"].append((steps["Commit"] - steps["Precommit"]) / 1e6)
+        ds, de = deliver_start.get(h), deliver_end.get(h)
+        if ds is not None:
+            stages["commit_persist"].append((ds - steps["Commit"]) / 1e6)
+            if de is not None:
+                stages["finalize"].append((de - ds) / 1e6)
+        nxt = chains.get(h + 1)
+        if nxt and "Propose" in nxt:
+            stages["next_propose"].append((nxt["Propose"] - steps["Commit"]) / 1e6)
+        if nxt and "Commit" in nxt:
+            c2c.append((nxt["Commit"] - steps["Commit"]) / 1e6)
+    if not c2c:
+        return None
+    out: dict = {"source": "flight_recorder", "blocks": len(c2c), "stages": {}}
+    for name in BUDGET_STAGES:
+        xs = stages[name]
+        if xs:
+            out["stages"][name] = {
+                "n": len(xs),
+                "p50_ms": round(_pctl(xs, 0.5), 3),
+                "p90_ms": round(_pctl(xs, 0.9), 3),
+                "max_ms": round(max(xs), 3),
+            }
+    out["commit_to_commit_p50_ms"] = round(_pctl(c2c, 0.5), 3)
+    out["commit_to_commit_p90_ms"] = round(_pctl(c2c, 0.9), 3)
+    return out
+
+
+def format_budget(budget: Optional[dict]) -> str:
+    """Aligned table rendering of a stage_budget dict (`trace --budget`)."""
+    if budget is None:
+        return "no complete consecutive span chains — nothing to budget"
+    lines = [
+        f"latency budget over {budget['blocks']} blocks  "
+        f"(commit-to-commit p50 {budget['commit_to_commit_p50_ms']} ms, "
+        f"p90 {budget['commit_to_commit_p90_ms']} ms)",
+        f"  {'stage':<15}{'n':>5}{'p50 ms':>10}{'p90 ms':>10}{'max ms':>10}",
+    ]
+    for name in BUDGET_STAGES:
+        st = budget["stages"].get(name)
+        if st is None:
+            continue
+        lines.append(
+            f"  {name:<15}{st['n']:>5}{st['p50_ms']:>10.3f}"
+            f"{st['p90_ms']:>10.3f}{st['max_ms']:>10.3f}"
+        )
+    return "\n".join(lines)
+
+
 #: The statesync bootstrap chain every snapshot restore must record, in
 #: order — the statesync-smoke acceptance gate.
 STATESYNC_CHAIN = ("statesync.offer", "statesync.chunk", "statesync.restore", "statesync.handover")

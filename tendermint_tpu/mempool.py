@@ -167,6 +167,11 @@ class Mempool:
         self.txs_bytes = 0
         self._lock = asyncio.Lock()
         self._seq = 0
+        #: bumped on EVERY content mutation (add / commit-removal /
+        #: eviction / recheck-drop / flush): an equal version proves a
+        #: reap would return the same set — the consensus pipeline's
+        #: speculative-proposal invalidation key
+        self.version = 0
         self._tx_log: List[MempoolTx] = []  # append-only, ordered by seq
         self._new_tx_event = asyncio.Event()  # wakes broadcast routines
         self._tx_available: Optional[asyncio.Event] = None
@@ -398,6 +403,7 @@ class Mempool:
                 mtx.senders.add(sender)
             self.txs[tx_hash(tx)] = mtx
             self.txs_bytes += len(tx)
+            self.version += 1
             self._tx_log.append(mtx)
             self._new_tx_event.set()
             self._wal_write(tx)
@@ -469,6 +475,7 @@ class Mempool:
         for victim in victims:
             self.txs.pop(tx_hash(victim.tx), None)
             self.txs_bytes -= len(victim.tx)
+            self.version += 1
             # let the evicted tx re-enter later (it was valid, just outbid)
             self.cache.remove(victim.tx)
             self.metrics.priority_evicted.inc()
@@ -551,6 +558,7 @@ class Mempool:
             mtx = self.txs.pop(tx_hash(tx), None)
             if mtx is not None:
                 self.txs_bytes -= len(mtx.tx)
+                self.version += 1
 
         if self.txs:
             if self.recheck:
@@ -571,6 +579,7 @@ class Mempool:
             if res.code != abci.CODE_TYPE_OK:
                 self.txs.pop(key, None)
                 self.txs_bytes -= len(mtx.tx)
+                self.version += 1
                 if not self.keep_invalid_txs_in_cache:
                     self.cache.remove(mtx.tx)
         if self.txs:
@@ -580,6 +589,7 @@ class Mempool:
         """Remove all txs + reset cache (clist_mempool.go Flush)."""
         self.txs.clear()
         self.txs_bytes = 0
+        self.version += 1
         self.cache.reset()
 
     # -- broadcast-routine support (mempool/reactor.go clist walk) ---------

@@ -227,21 +227,29 @@ class BlockExecutor:
         return abci.LastCommitInfo(round=round_, votes=votes)
 
     async def _fire_events(self, block: Block, abci_responses: dict, validator_updates) -> None:
-        """state/execution.go:449."""
+        """state/execution.go:449.  Publication must never stall or break
+        the commit path: fan-out goes through the pubsub's bounded
+        per-subscriber queues (put_nowait; a subscriber that stops
+        draining is cancelled "out of capacity" — libs/events), and any
+        publication failure is logged, not raised — a broken subscriber
+        pipe is not a consensus fault."""
         if self.event_bus is None:
             return
-        await self.event_bus.publish_new_block(
-            block, abci_responses["begin_block"], abci_responses["end_block"]
-        )
-        await self.event_bus.publish_new_block_header(block.header)
-        for i, tx in enumerate(block.txs):
-            r = abci_responses["deliver_txs"][i]
-            events = _abci_events_to_map(r.events)
-            await self.event_bus.publish_tx(
-                block.height, i, tx, {"code": r.code, "data": r.data, "log": r.log}, events
+        try:
+            await self.event_bus.publish_new_block(
+                block, abci_responses["begin_block"], abci_responses["end_block"]
             )
-        if validator_updates:
-            await self.event_bus.publish_validator_set_updates(validator_updates)
+            await self.event_bus.publish_new_block_header(block.header)
+            for i, tx in enumerate(block.txs):
+                r = abci_responses["deliver_txs"][i]
+                events = _abci_events_to_map(r.events)
+                await self.event_bus.publish_tx(
+                    block.height, i, tx, {"code": r.code, "data": r.data, "log": r.log}, events
+                )
+            if validator_updates:
+                await self.event_bus.publish_validator_set_updates(validator_updates)
+        except Exception as e:
+            self.log.error("event publication failed", height=block.height, err=repr(e))
 
     # -- fast-sync variant -------------------------------------------------
     async def exec_commit_block(self, state: State, block: Block) -> bytes:
@@ -316,6 +324,34 @@ def update_state(
         consensus_params=next_params,
         last_height_consensus_params_changed=last_height_params_changed,
         last_results_hash=abci_results_hash(abci_responses["deliver_txs"]),
+        app_hash=b"",
+    )
+
+
+def provisional_next_state(state: State, block_id: BlockID, block: Block) -> State:
+    """The delivery-independent slice of update_state: everything height
+    H+1's round machinery can know before H's ABCI responses exist, so
+    the pipelined consensus lane can advance while delivery runs.
+
+    Validator rotation is fully pre-knowable: update_state promotes
+    `next_validators` verbatim (no priority touch) into `validators`, and
+    EndBlock updates only land in the NEW next_validators (effective
+    H+2) — so H+1's proposer selection under this state is identical to
+    the delivered one.  app_hash, last_results_hash, validator updates
+    and consensus-param updates ARE delivery outputs: they stay at their
+    pre-knowable placeholders and the awaiter swaps in the delivered
+    state wholesale before anyone reads them."""
+    n_val_set = state.next_validators.copy()
+    n_val_set.increment_proposer_priority(1)
+    return replace(
+        state,
+        last_block_height=block.height,
+        last_block_id=block_id,
+        last_block_time_ns=block.time_ns,
+        next_validators=n_val_set,
+        validators=state.next_validators.copy(),
+        last_validators=state.validators.copy(),
+        last_results_hash=b"",
         app_hash=b"",
     )
 

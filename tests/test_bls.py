@@ -989,9 +989,9 @@ class TestCTier:
 
     def test_sign_verify_identical_across_tiers(self, force_pure_tier):
         """Signatures are deterministic ([sk]H(m)) so the two tiers must
-        produce BYTE-IDENTICAL signatures and identical verdicts; the
-        RFC 9380 K.1-pinned expand_message_xmd feeds both (hash-to-curve
-        stays Python in both tiers)."""
+        produce BYTE-IDENTICAL signatures and identical verdicts; the C
+        tier runs the whole hash-to-curve in C (bit-identical to the pure
+        map, pinned by TestCTierHashToCurve below)."""
         from tendermint_tpu.crypto.bls import ctier
 
         sk = scheme.keygen(b"\x42" * 32)
@@ -1166,6 +1166,85 @@ class TestCTier:
         assert scheme.memo_get(pks, msg, sig) is True
         ctier.set_forced("pure")
         assert scheme.memo_get(pks, msg, sig) is True
+
+
+@pytest.mark.skipif(not _ctier_available(), reason="no C toolchain")
+class TestCTierHashToCurve:
+    """The C hash-to-curve lane (expand_message_xmd + SVDW map-to-G2 +
+    clear cofactor, all in csrc/bls12_381.c): RFC 9380 K.1 KATs replayed
+    through the C path, and C-vs-pure BIT-IDENTICAL affine points — the
+    derived SvdW constants, fp2 sqrt root choice, and sgn0 fixes must all
+    agree with the reference tier, not just land in the same orbit."""
+
+    def test_expand_message_xmd_rfc9380_vectors_through_c(self):
+        """Same §K.1 (SHA-256, len 0x20) vectors TestReferenceTier pins on
+        the pure side, through bls381_expand_xmd."""
+        from tendermint_tpu.crypto.bls import ctier
+
+        dst = b"QUUX-V01-CS02-with-expander-SHA256-128"
+        vectors = [
+            (b"", "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"),
+            (b"abc", "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"),
+            (b"abcdef0123456789",
+             "eff31487c770a893cfb36f912fbfcbff40d5661771ca4b2cb4eafe524333f5c1"),
+            (b"q128_" + b"q" * 128,
+             "b23a1d2b4d97b2ef7785562a7e8bac7eed54ed6e97e29aa51bfe3f12ddad1ff9"),
+            (b"a512_" + b"a" * 512,
+             "4623227bcc01293b8c130bf771da8c298dede7383243dc0993d2d94823958c4c"),
+        ]
+        for msg, want in vectors:
+            assert ctier.expand_message_xmd(msg, dst, 0x20).hex() == want
+
+    def test_expand_message_xmd_differential(self):
+        """Byte-identical to the pure expander across output lengths,
+        multi-block ell, and the oversize-DST (>255 B) hashing rule."""
+        from tendermint_tpu.crypto.bls import ctier
+
+        dsts = [b"QUUX-V01-CS02-with-expander-SHA256-128", scheme.DST_SIG,
+                b"D" * 300]
+        for dst in dsts:
+            for msg in (b"", b"abc", b"m" * 257):
+                for n in (0, 1, 0x20, 0x21, 0x80, 255):
+                    assert ctier.expand_message_xmd(msg, dst, n) == (
+                        expand_message_xmd(msg, dst, n)
+                    ), (dst[:8], msg[:8], n)
+        with pytest.raises(ValueError):
+            ctier.expand_message_xmd(b"x", scheme.DST_SIG, 256 * 32 + 1)
+
+    def test_hash_to_g2_bit_identical_to_pure(self):
+        """The acceptance pin: C and pure hash_to_g2 produce the SAME
+        affine point bit for bit, over both suite DSTs and messages that
+        exercise every map branch (e1/e2/x3 selection, sign flips)."""
+        import hashlib as _hl
+
+        from tendermint_tpu.crypto.bls import ctier
+
+        msgs = [b"", b"consensus msg", b"x" * 300] + [
+            _hl.sha256(bytes([i])).digest() for i in range(8)
+        ]
+        for dst in (scheme.DST_SIG, scheme.DST_POP):
+            for msg in msgs:
+                c_blob = ctier.hash_to_g2_blob(msg, dst)
+                pure_blob = ctier.g2_blob(hash_to_g2(msg, dst))
+                assert c_blob == pure_blob, (dst[:12], msg[:12])
+                # and the point is in the right subgroup
+                assert curve.g2_in_subgroup(ctier.g2_point(c_blob))
+
+    def test_scheme_hash_cache_routes_through_c(self, force_pure_tier):
+        """hash_to_g2_cached returns the same point whichever tier fills
+        the memo — a warm pure cache stays valid across a tier flip."""
+        from tendermint_tpu.crypto.bls import ctier
+
+        msg = b"tier-flip hash cache"
+        assert scheme.active_tier() == "pure"
+        pure_pt = scheme.hash_to_g2_cached(msg, scheme.DST_SIG)
+        ctier.set_forced(None)
+        assert scheme.active_tier() == "c"
+        # evict the warm entry so the C lane actually computes
+        scheme._h2g.pop((msg, scheme.DST_SIG), None)
+        c_pt = scheme.hash_to_g2_cached(msg, scheme.DST_SIG)
+        assert curve.g2_eq(pure_pt, c_pt)
+        assert ctier.g2_blob(pure_pt) == ctier.g2_blob(c_pt)
 
 
 class TestCTierFallback:
