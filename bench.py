@@ -420,7 +420,11 @@ def bench_scale_100val():
     that replace the old "Python-loop-bound" narrative with measurement:
     `loop_lag_ms_p90_100val`, `commit_skew_ms_100val` and
     `block_attribution_100val` (loop-task / GC / loop-lag / idle shares
-    of each block's wall time, merged from all 100 recorders).  Raises
+    of each block's wall time, merged from all 100 recorders), plus the
+    network-plane numbers from wire-level trace context:
+    `vote_fanin_ms`, `part_stream_ms`, `gossip_hop_p90_ms` and
+    `measured_skew_nodes` (nodes whose merge alignment came from
+    measured origin-vs-receive latency, not landmark estimation).  Raises
     if the net failed to commit, any invariant was violated, or the heal
     never recovered."""
     import subprocess
@@ -470,7 +474,11 @@ def bench_finality():
     and report `commit_to_commit_p50_ms`/`commit_to_commit_p90_ms`
     (pipelined idle), `commit_to_commit_p50_ms_serial` (the A/B
     baseline), `finality_under_load_p50_ms` (under a tools/loadgen.py
-    firehose) and both arms' per-stage budgets.  Raises on any checker
+    firehose), both arms' per-stage budgets, and the pipelined arm's
+    cross-node net budget: `vote_fanin_ms` (first vote seen → +2/3),
+    `part_stream_ms` (first part → part set complete) and
+    `gossip_hop_p90_ms` (wire-level trace-context propagation latency).
+    Raises on any checker
     violation, a p50 >= 100 ms, or a p50 regression past the serial
     arm — the smoke gates, not just the bench."""
     import subprocess

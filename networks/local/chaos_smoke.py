@@ -34,6 +34,12 @@ from every node each poll and accumulates violations:
     (The twin is exempt: it reference-correctly halts on its own
     conflict, and its stall alarm firing is the watchdog being right.)
 
+  - byzantine trace context: the twin forges a huge hop count and a
+    far-future origin timestamp on its equivocation frames; at least one
+    honest receiver must CLAMP them (gossip.hop `clamped`, counted via
+    watermarked polls during the run) — forged wire trace fields are
+    never trusted into skew estimation
+
 With --json the last stdout line carries `chaos_partition_recovery_ms`
 (heal -> first new commit, wall ms) — the number bench.py reports.
 """
@@ -208,6 +214,36 @@ def main() -> int:
                     stall_free = False
             return stall_free
 
+        # wire-level trace forensics: the twin forges byzantine trace
+        # context (huge hop count, far-future origin timestamp) on its
+        # equivocation frames; honest receivers must CLAMP and count, never
+        # trust it into skew estimation.  Polled watermarked DURING the run
+        # (throttled) so ring eviction can't hide early forgeries.
+        trace_state = {"wm": {}, "clamps": 0, "hops": 0, "last_t": 0.0}
+
+        def poll_trace_clamps():
+            if time.time() - trace_state["last_t"] < 2.0:
+                return
+            trace_state["last_t"] = time.time()
+            for i, p in enumerate(ports):
+                if i == 0 or not live[i]:
+                    continue
+                try:
+                    snap = rpc_call(
+                        p, "dump_flight_recorder",
+                        since=trace_state["wm"].get(i, 0), kinds="gossip.hop",
+                    )["result"]
+                except Exception:
+                    continue
+                trace_state["wm"][i] = snap.get(
+                    "next_seq", trace_state["wm"].get(i, 0)
+                )
+                evs = snap.get("events", [])
+                trace_state["hops"] += len(evs)
+                trace_state["clamps"] += sum(
+                    1 for ev in evs if ev.get("clamped")
+                )
+
         def scrape():
             hs = []
             for i, p in enumerate(ports):
@@ -252,6 +288,7 @@ def main() -> int:
             while time.time() < t0 + ev.t:
                 scrape()
                 poll_health()
+                poll_trace_clamps()
                 time.sleep(0.4)
             print(f"+{time.time() - t0:6.2f}s executing {ev.describe()}")
             if ev.action == "twin":
@@ -309,6 +346,7 @@ def main() -> int:
         deadline = time.time() + args.budget
         while time.time() < deadline:
             scrape()
+            poll_trace_clamps()
             if poll_health() and hstate["clear_t"] is None:
                 hstate["clear_t"] = time.time()
                 print(f"  watchdog: consensus_stall clear on every live "
@@ -359,6 +397,8 @@ def main() -> int:
             "heights": [height_of(p) for p in ports],
             "twin_equivocations": rpc(ports[0], "unsafe_chaos_status")
             ["result"]["equivocations"],
+            "trace_clamps": trace_state["clamps"],
+            "gossip_hop_events": trace_state["hops"],
             **checker.summary(),
         }
         failures = []
@@ -389,6 +429,12 @@ def main() -> int:
             failures.append(
                 "watchdog consensus_stall never cleared on every live "
                 "non-twin node after recovery"
+            )
+        if trace_state["clamps"] < 1:
+            failures.append(
+                "no clamped trace context observed: the twin's forged "
+                "hop/origin fields were either not sent or TRUSTED by a "
+                "receiver"
             )
         if failures:
             print("CHAOS SMOKE FAILED:", file=sys.stderr)

@@ -27,8 +27,10 @@ pipelined p50 regressing past --regress-tolerance x the serial p50; a
 stall under the firehose; too few cross-checked heights.
 
 With --json the last stdout line carries `commit_to_commit_p50_ms`,
-`commit_to_commit_p90_ms`, `finality_under_load_p50_ms` and both arms'
-stage budgets — the numbers bench.py reports as bench_finality.
+`commit_to_commit_p90_ms`, `finality_under_load_p50_ms`, both arms'
+stage budgets, and the pipelined arm's cross-node net budget
+(`vote_fanin_ms`, `part_stream_ms`, `gossip_hop_p90_ms` plus the full
+`net_budget` breakdown) — the numbers bench.py reports as bench_finality.
 """
 
 import argparse
@@ -164,13 +166,16 @@ def stop_net(procs) -> None:
 
 def measure_budget(ports, checker, window: float):
     """Scrape the checker for `window` seconds, then decompose node0's
-    recorder events from the window into a stage budget."""
+    recorder events from the window into both budgets: the local stage
+    budget and the cross-node net budget (proposal propagation, part
+    stream, vote fan-in, hop latencies — wire-level trace context)."""
     mark = recorder_seq(ports[0])
     deadline = time.time() + window
     while time.time() < deadline:
         scrape(checker, ports)
         time.sleep(0.4)
-    return tracing.stage_budget(recorder_events(ports[0], mark))
+    events = recorder_events(ports[0], mark)
+    return tracing.stage_budget(events), tracing.net_budget(events)
 
 
 async def _load_phase(ports, checker, args):
@@ -245,7 +250,7 @@ def main() -> int:
         homes, ports = build_testnet(build, args.base_port, pipeline_on=False)
         procs = start_net(homes, env, ports)
         print(f"serial arm ready, heights {[height_of(p) for p in ports]}")
-        budget_serial = measure_budget(ports, checker_serial, args.measure)
+        budget_serial, _ = measure_budget(ports, checker_serial, args.measure)
         stop_net(procs)
         procs = []
         if budget_serial:
@@ -255,9 +260,11 @@ def main() -> int:
         homes, ports = build_testnet(build, args.base_port, pipeline_on=True)
         procs = start_net(homes, env, ports)
         print(f"pipelined arm ready, heights {[height_of(p) for p in ports]}")
-        budget_on = measure_budget(ports, checker, args.measure)
+        budget_on, net_on = measure_budget(ports, checker, args.measure)
         if budget_on:
             print("pipelined " + tracing.format_budget(budget_on).replace("\n", "\n  "))
+        if net_on:
+            print("pipelined " + tracing.format_net_budget(net_on).replace("\n", "\n  "))
 
         # firehose window: finality under ingress pressure
         mark = recorder_seq(ports[0])
@@ -275,15 +282,22 @@ def main() -> int:
         p50_on = budget_on["commit_to_commit_p50_ms"] if budget_on else -1.0
         p90_on = budget_on["commit_to_commit_p90_ms"] if budget_on else -1.0
         p50_load = budget_load["commit_to_commit_p50_ms"] if budget_load else -1.0
+        net_stages = (net_on or {}).get("stages", {})
         result = {
             "metric": "finality_smoke",
             "commit_to_commit_p50_ms": p50_on,
             "commit_to_commit_p90_ms": p90_on,
             "commit_to_commit_p50_ms_serial": p50_serial,
             "finality_under_load_p50_ms": p50_load,
+            "vote_fanin_ms": net_stages.get("vote_fanin", {}).get("p50_ms", -1.0),
+            "part_stream_ms": net_stages.get("part_stream", {}).get("p50_ms", -1.0),
+            "gossip_hop_p90_ms": (net_on or {}).get(
+                "hop_lat_all_ms", {}
+            ).get("p90", -1.0),
             "budget_serial": budget_serial,
             "budget_pipelined": budget_on,
             "budget_under_load": budget_load,
+            "net_budget": net_on,
             "offered_tps": load["offered_tps"],
             "tx_ingress_sustained_tps": load["tx_ingress_sustained_tps"],
             "commits_under_load": load["commits_under_load"],

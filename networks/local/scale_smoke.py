@@ -266,7 +266,7 @@ def profile_net(nodes, dump_dir: str = "") -> dict:
     so its attribution is the process attribution — the replacement for
     the old "Python-loop-bound" narrative.  Dumps optionally land in
     `dump_dir` (one JSON per node) for offline `trace-net` runs."""
-    from tendermint_tpu.libs import tracemerge
+    from tendermint_tpu.libs import tracemerge, tracing
 
     dumps = []
     for i, node in enumerate(nodes):
@@ -292,6 +292,22 @@ def profile_net(nodes, dump_dir: str = "") -> dict:
     merged = tracemerge.merge(dumps)
     out["commit_skew_ms_100val"] = merged["commit_skew_ms_p90"]
     out["part_coverage_ms_p90_100val"] = merged["coverage_ms_p90"]
+    # how many nodes got MEASURED (wire trace context) rather than
+    # landmark-estimated clock alignment in the merge
+    out["measured_skew_nodes"] = sum(
+        1 for s in merged.get("offset_sources", []) if s == "measured"
+    )
+    # cross-node net budget from one receiver's events (the stages are
+    # per-receiver by construction; any non-proposer-biased node works)
+    netb = tracing.net_budget(dumps[0]["events"]) if dumps else None
+    if netb:
+        out["net_budget"] = netb
+        st = netb["stages"]
+        out["vote_fanin_ms"] = st.get("vote_fanin", {}).get("p50_ms", -1.0)
+        out["part_stream_ms"] = st.get("part_stream", {}).get("p50_ms", -1.0)
+        out["gossip_hop_p90_ms"] = netb.get("hop_lat_all_ms", {}).get("p90", -1.0)
+        print("net " + tracing.format_net_budget(netb).replace("\n", "\n  "),
+              flush=True)
     att = None
     for d in dumps:  # only the hook-owning node carries loop.busy events
         att = tracemerge.median_attribution(tracemerge.attribution_by_height(d))

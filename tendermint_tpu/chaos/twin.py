@@ -28,6 +28,7 @@ commit).
 
 from __future__ import annotations
 
+import time
 from dataclasses import replace
 from typing import Optional
 
@@ -160,7 +161,19 @@ def install_twin(node, vote_types=(PREVOTE_TYPE,)) -> None:
             "twin equivocating", height=vote.height, round=vote.round,
             real=vote.block_id.hash.hex()[:12], twin=conflict.block_id.hash.hex()[:12],
         )
-        frame = _enc("vote", {"vote": conflict.to_dict()})
+        # byzantine trace context on the equivocation frame: an absurd hop
+        # count and a far-future origin timestamp.  Honest receivers must
+        # CLAMP both (reactor._trace_recv) — counted, never trusted into
+        # skew estimation — which chaos_smoke asserts end to end.
+        frame = _enc(
+            "vote",
+            {
+                "vote": conflict.to_dict(),
+                "o": "twin-forged-origin",
+                "ow": time.time_ns() + 600 * 1_000_000_000,
+                "hp": 1 << 20,
+            },
+        )
         sw.spawn(sw.broadcast(VOTE_CHANNEL, frame), f"twin-equivocate-{vote.height}")
 
     cs.on_vote.append(_on_vote)

@@ -380,6 +380,16 @@ def cmd_trace(args) -> int:
 
     snap = asyncio.run(fetch())
     events = snap.get("events", [])
+    if args.net_budget:
+        # cross-node stage budget from THIS node's events alone: proposal
+        # propagation, part-stream completion, vote fan-in to quorum, and
+        # hop-count/latency distributions (wire-level trace context)
+        budget = tracing.net_budget(events)
+        if args.json:
+            print(json.dumps({"net_budget": budget}))
+        else:
+            print(tracing.format_net_budget(budget))
+        return 0 if budget is not None else 1
     if args.budget:
         # per-stage latency budget: propose→prevote→precommit→
         # commit(persist)→finalize(deliver)→next-propose + c2c percentiles
@@ -784,6 +794,44 @@ def cmd_debug_dump(args) -> int:
     return 0
 
 
+def cmd_debug_watch(args) -> int:
+    """Live fleet telescope (tools/telescope.py): continuously poll every
+    node's flight recorder / health / status with per-node watermarks,
+    live-merge the rolling window into one network timeline (measured
+    skew when peers speak the wire trace tier), and render a refreshing
+    fleet-health dashboard — tip spread, per-node lag, quorum latency,
+    hop latencies, stalled part streams.  Survives nodes dying mid-run:
+    every per-node poll is independently fallible, dead nodes stay on
+    the board marked DOWN while the survivors' timeline keeps merging."""
+    from .tools.telescope import Telescope
+
+    targets = [t for t in args.rpc.split(",") if t]
+    if not targets:
+        print("no targets given (--rpc host:port,host:port,...)", file=sys.stderr)
+        return 2
+    tele = Telescope(
+        targets,
+        interval=args.interval,
+        window=args.window,
+        serve_addr=args.serve or None,
+    )
+    try:
+        if args.once:
+            asyncio.run(tele.run(cycles=1, dashboard=False))
+            print(json.dumps(tele.last_snapshot, default=repr))
+            return 0
+        asyncio.run(
+            tele.run(
+                cycles=args.cycles if args.cycles > 0 else None,
+                dashboard=not args.json,
+                json_lines=args.json,
+            )
+        )
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_debug_kill(args) -> int:
     """commands/debug/kill.go — capture a bundle from the running node,
     then SIGKILL its pid: the evidence is on disk BEFORE the process
@@ -924,6 +972,40 @@ def build_parser() -> argparse.ArgumentParser:
     )
     dp.set_defaults(fn=cmd_debug_dump)
     dp = dsub.add_parser(
+        "watch",
+        help="live fleet telescope: poll every node's recorder/health/"
+        "status, live-merge a rolling network timeline with measured "
+        "clock skew, render a refreshing fleet-health dashboard",
+    )
+    dp.add_argument(
+        "--rpc", required=True,
+        help="comma-separated node RPC laddrs (host:port,host:port,...)",
+    )
+    dp.add_argument(
+        "--interval", type=float, default=1.0, help="seconds between poll sweeps"
+    )
+    dp.add_argument(
+        "--window", type=int, default=5000,
+        help="rolling per-node event-buffer size (oldest evicted first)",
+    )
+    dp.add_argument(
+        "--serve", default="",
+        help="host:port for the JSON snapshot endpoint (GET /snapshot)",
+    )
+    dp.add_argument(
+        "--cycles", type=int, default=0,
+        help="stop after N poll sweeps (0 = run until interrupted)",
+    )
+    dp.add_argument(
+        "--once", action="store_true",
+        help="one poll sweep, print the JSON snapshot, exit",
+    )
+    dp.add_argument(
+        "--json", action="store_true",
+        help="emit one JSON snapshot line per sweep instead of the dashboard",
+    )
+    dp.set_defaults(fn=cmd_debug_watch)
+    dp = dsub.add_parser(
         "kill", help="capture a bundle from the node, then SIGKILL its pid"
     )
     dp.add_argument("pid", type=int, help="pid of the tendermint_tpu node process")
@@ -947,6 +1029,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--budget",
         action="store_true",
         help="per-stage latency budget table (propose→…→finalize→next-propose)",
+    )
+    sp.add_argument(
+        "--net-budget",
+        action="store_true",
+        help="cross-node stage budget from this node's gossip.hop events: "
+        "proposal propagation, part-stream completion, vote fan-in to "
+        "quorum, hop-count/latency distributions",
     )
     sp.set_defaults(fn=cmd_trace)
 

@@ -222,6 +222,17 @@ class ConsensusConfig:
     # laggard more than just receiving the stream (measured 3× block time
     # at 4 validators).
     gossip_vote_summary: bool = True
+    # Wire-level trace context: stamp outbound `vote` / `vote_batch` /
+    # `vote_summary` / `block_part` / `proposal` / `agg_commit` frames to
+    # capable peers (NodeInfo gossip_version >= 3) with optional origin
+    # fields — sender id, monotonic-anchored wall ns at send, content hop
+    # count (+1 per relay) — and emit sampled `gossip.hop` recorder
+    # events on receipt, so the flight recorder carries the dissemination
+    # tree (`net_budget`, tracemerge measured skew, the fleet telescope).
+    # Requires the batch + summary tiers below it (capabilities are
+    # cumulative); frames to older peers omit the fields, so mixed nets
+    # converge exactly like the vote_batch rollout.
+    gossip_trace_context: bool = True
     # Flow-control window: block parts transmitted per gossip wakeup
     # (rarest-first across peers instead of pick_random).
     gossip_part_burst: int = 8
@@ -366,9 +377,12 @@ class InstrumentationConfig:
     flight_recorder: bool = True
     flight_recorder_size: int = 8192
     # 1-in-N sampling for HIGH-RATE recorder kinds (gossip.wakeup fires
-    # per wakeup; at ~700 connections it can evict the whole ring between
-    # commits).  Sampled events carry `sampled=N` so consumers re-scale;
-    # 1 (default) records everything — the small-net behavior.
+    # per wakeup; gossip.hop fires per traced frame received — at N=100
+    # either can evict the whole ring between commits).  Sampled events
+    # carry `sampled=N` so consumers re-scale; 1 (default) records
+    # everything — the small-net behavior.  Trace-context stamping itself
+    # is not sampled (relays always need the hop count); only the
+    # recorder emission is.
     trace_sample_high_rate: int = 1
     # Asyncio scheduler profiler (libs/loopprof.py): loop-lag probe,
     # per-category task time accounting through Service.spawn, GC-pause

@@ -48,7 +48,13 @@ Wire compatibility: `vote_batch` (and the summary exchange) is negotiated
 via NodeInfo.gossip_version (p2p/node_info.py) — peers that never
 advertised it (older nodes, or `consensus.gossip_vote_batch = false`)
 receive the reference's single-vote messages, peers at version 1 get
-batches but no summaries, so mixed-version nets still converge.
+batches but no summaries, so mixed-version nets still converge.  Version
+3 adds wire-level trace context: frames to capable peers carry optional
+origin fields (`o`/`ow`/`hp`) and receivers emit sampled `gossip.hop`
+recorder events, so the flight recorder carries the dissemination tree
+(libs/tracing.net_budget consumes it).  Frames to older peers omit the
+fields; received unknown fields were always ignored, so rollout is
+exactly the vote_batch rollout.
 """
 
 from __future__ import annotations
@@ -63,7 +69,11 @@ from ..encoding import codec
 from ..libs.bitarray import BitArray
 from ..libs.log import get_logger
 from ..p2p import ChannelDescriptor, Reactor
-from ..p2p.node_info import GOSSIP_BATCH_VERSION, GOSSIP_SUMMARY_VERSION
+from ..p2p.node_info import (
+    GOSSIP_BATCH_VERSION,
+    GOSSIP_SUMMARY_VERSION,
+    GOSSIP_TRACE_VERSION,
+)
 from ..types import BlockID, Proposal, Vote
 from ..types.agg_commit import AggregateCommit, AggregateLastCommit
 from ..types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
@@ -85,6 +95,23 @@ MAX_VOTE_BATCH_ENTRIES = 16384
 # already batch-shaped, and the flusher's scheduling hops dominate at
 # committee scale (smaller trickles still coalesce across peers).
 DIRECT_VERIFY_MIN = 16
+
+# Wire-level trace context (gossip_version >= 3): outbound frames to
+# capable peers carry `o` (origin/sender node id prefix), `ow` (sender
+# wall ns at send, monotonic-anchored via the recorder's wall fn so
+# chaos clock skew is visible), `hp` (content hop count: 0 = the
+# content originated at the sender, +1 per relay).  Both fields are
+# attacker-suppliable, so receivers CLAMP before recording: a hop
+# outside [0, TRACE_MAX_HOP] or an origin timestamp further than
+# TRACE_MAX_LAT_NS from our wall clock marks the gossip.hop event
+# `clamped` and withholds the latency sample from skew estimation —
+# a byzantine peer can inflate the clamp counter, never the measured
+# offsets (the dissemination-tree analogue of the vote_batch entry cap).
+TRACE_MAX_HOP = 64
+TRACE_MAX_LAT_NS = 60 * 1_000_000_000  # ±60 s sanity window
+# hop-context table bound: one entry per in-flight proposal/part/agg
+# key; eviction only costs a relay restarting its hop count at 0
+TRACE_CTX_CAP = 1024
 
 
 class PeerRoundState:
@@ -302,6 +329,15 @@ class ConsensusReactor(Reactor):
 
         self._part_frames: "OrderedDict[tuple, bytes]" = OrderedDict()
         self._part_frames_cap = 256
+        # wire-level trace context: received content hop counts keyed by
+        # ("prop", h, r) / ("part", h, r, idx) / ("agg", h) so relayed
+        # frames can be stamped hop+1 (absence = we originated → hop 0).
+        # Independent of gossip.hop sampling — relays always need it.
+        self._trace_hops: "OrderedDict[tuple, int]" = OrderedDict()
+        self._trace_id = ""  # our node id prefix, resolved lazily
+        # clamped trace fields seen (byzantine/garbled hop or timestamp);
+        # mirrored into metrics, polled by chaos-smoke's twin assertion
+        self.trace_clamps = 0
         cs.on_new_round_step.append(self._on_new_round_step)
         cs.on_vote.append(self._on_vote_event)
         cs.on_valid_block.append(self._on_valid_block)
@@ -464,6 +500,89 @@ class ConsensusReactor(Reactor):
             and getattr(peer, "gossip_version", 0) >= GOSSIP_SUMMARY_VERSION
         )
 
+    def _peer_traced(self, peer) -> bool:
+        """True when outbound frames to this peer may carry wire-level
+        trace context (negotiated like vote_batch, one level up again)."""
+        return (
+            self.cs.config.gossip_vote_batch
+            and self.cs.config.gossip_vote_summary
+            and self.cs.config.gossip_trace_context
+            and getattr(peer, "gossip_version", 0) >= GOSSIP_TRACE_VERSION
+        )
+
+    # -- wire-level trace context ------------------------------------------
+    def _trace_wall_ns(self) -> int:
+        """Wall ns through the recorder's anchor fn when present — under
+        clock-skew chaos that is the node's SKEWED clock, which is exactly
+        what makes the skew measurable at the receiver."""
+        fn = getattr(self.cs.recorder, "_wall_ns_fn", None)
+        return fn() if fn is not None else time.time_ns()
+
+    def _trace_origin_id(self) -> str:
+        oid = self._trace_id
+        if not oid:
+            oid = (getattr(self.switch, "node_id", "") or "")[:16]
+            self._trace_id = oid
+        return oid
+
+    def _stamp_trace(self, fields: dict, hop: int) -> dict:
+        """Stamp a frame's field dict with trace context (sender id, send
+        wall ns, content hop count).  Callers gate on _peer_traced."""
+        fields["o"] = self._trace_origin_id()
+        fields["ow"] = self._trace_wall_ns()
+        fields["hp"] = hop
+        return fields
+
+    def _store_hop(self, key: tuple, hop: int) -> None:
+        self._trace_hops[key] = hop
+        while len(self._trace_hops) > TRACE_CTX_CAP:
+            self._trace_hops.popitem(last=False)
+
+    def _content_hop(self, key: tuple) -> int:
+        """Hop count to stamp on a relay of `key`: received-hop + 1, or 0
+        when we originated the content (no stored entry)."""
+        hop = self._trace_hops.get(key)
+        return 0 if hop is None else min(hop + 1, TRACE_MAX_HOP)
+
+    def _trace_recv(self, frame: str, peer, msg: dict, height=None) -> Optional[int]:
+        """Decode (and clamp) trace context off a received frame; emit a
+        sampled `gossip.hop` recorder event; return the hop count for the
+        caller to store for relays (None = no trace context on the frame).
+
+        Every field is attacker-suppliable: hop is clamped into
+        [0, TRACE_MAX_HOP], and the propagation-latency sample is emitted
+        only when the origin timestamp lands inside the ±TRACE_MAX_LAT_NS
+        sanity window AND nothing else was clamped — a forged frame gets
+        `clamped=1` and a counter bump, never a say in skew estimation."""
+        ow = msg.get("ow")
+        if not isinstance(ow, int) or isinstance(ow, bool):
+            return None
+        hp = msg.get("hp")
+        origin = msg.get("o")
+        clamped = False
+        if not isinstance(hp, int) or isinstance(hp, bool) or hp < 0:
+            hp, clamped = 0, True
+        elif hp > TRACE_MAX_HOP:
+            hp, clamped = TRACE_MAX_HOP, True
+        fields = {
+            "frame": frame,
+            "peer": peer.id[:8],
+            "origin": origin[:8] if isinstance(origin, str) else "",
+            "hop": hp,
+        }
+        if isinstance(height, int) and not isinstance(height, bool):
+            fields["h"] = height
+        lat_ns = self._trace_wall_ns() - ow
+        if clamped or not -TRACE_MAX_LAT_NS <= lat_ns <= TRACE_MAX_LAT_NS:
+            clamped = True
+            fields["clamped"] = 1
+            self.trace_clamps += 1
+            self.cs.metrics.trace_clamps.inc()
+        else:
+            fields["lat_ms"] = round(lat_ns / 1e6, 3)
+        self.cs.recorder.record_sampled("gossip.hop", **fields)
+        return hp
+
     # -- relay topology ----------------------------------------------------
     def _relay_targets(self, height: int, round_: int) -> Optional[Set[str]]:
         """The deterministic O(d) relay subset of connected peers for
@@ -527,6 +646,7 @@ class ConsensusReactor(Reactor):
             elif kind == "vote_set_maj23":
                 await self._handle_vote_set_maj23(peer, msg)
             elif kind == "vote_summary":
+                self._trace_recv("vote_summary", peer, msg, msg.get("height"))
                 await self._handle_vote_summary(peer, ps, msg)
         elif self.wait_sync:
             return  # ignore data/votes while fast-syncing (reactor.go:231)
@@ -538,6 +658,9 @@ class ConsensusReactor(Reactor):
                 except ValueError as e:
                     await self.switch.stop_peer_for_error(peer, f"invalid proposal: {e}")
                     return
+                hp = self._trace_recv("proposal", peer, msg, proposal.height)
+                if hp is not None:
+                    self._store_hop(("prop", proposal.height, proposal.round), hp)
                 ps.set_has_proposal(proposal)
                 await self.cs.set_proposal_input(proposal, peer.id)
             elif kind == "proposal_pol":
@@ -550,6 +673,11 @@ class ConsensusReactor(Reactor):
                 except ValueError as e:
                     await self.switch.stop_peer_for_error(peer, f"invalid block part: {e}")
                     return
+                hp = self._trace_recv("block_part", peer, msg, msg.get("height"))
+                if hp is not None:
+                    self._store_hop(
+                        ("part", msg["height"], msg["round"], part.index), hp
+                    )
                 ps.set_has_proposal_block_part(msg["height"], msg["round"], part.index)
                 await self.cs.add_block_part_input(msg["height"], msg["round"], part, peer.id)
         elif chan_id == VOTE_CHANNEL:
@@ -561,6 +689,9 @@ class ConsensusReactor(Reactor):
                 except ValueError as e:
                     await self.switch.stop_peer_for_error(peer, f"invalid vote: {e}")
                     return
+                hp = self._trace_recv("vote", peer, msg, vote.height)
+                if hp is not None:
+                    vote._trace_hop = hp
                 self._mark_peer_vote(ps, vote)
                 if self._already_have_vote(vote):
                     return  # duplicate relay; already verified and stored
@@ -580,6 +711,9 @@ class ConsensusReactor(Reactor):
                 except Exception as e:
                     await self.switch.stop_peer_for_error(peer, f"invalid agg_commit: {e}")
                     return
+                hp = self._trace_recv("agg_commit", peer, msg, commit.height)
+                if hp is not None:
+                    self._store_hop(("agg", commit.height), hp)
                 # signature verification (one pairing) happens inside the
                 # consensus routine against OUR validator set; a forged
                 # commit is dropped there
@@ -660,6 +794,12 @@ class ConsensusReactor(Reactor):
             votes.append(vote)
         if not votes:
             return
+        hp = self._trace_recv("vote_batch", peer, msg, votes[0].height)
+        if hp is not None:
+            # per-vote content hop: our own relay of these votes stamps
+            # max(stored)+1, so hop counts never decrement along a path
+            for vote in votes:
+                vote._trace_hop = hp
         # piggybacked possession bitmap: fold the sender's full bit array
         # for the set into our belief (it covers votes it received from
         # third parties — the anti-echo half of the relay topology)
@@ -832,11 +972,16 @@ class ConsensusReactor(Reactor):
         maj23, _ = vote_set.two_thirds_majority()
         if maj23 is None:
             return False
-        ok = await peer.send(STATE_CHANNEL, _enc("vote_summary", {
+        fields = {
             "height": vote_set.height, "round": vote_set.round,
             "type": vote_set.signed_msg_type, "block_id": maj23.to_dict(),
             "votes": bits.to_bytes(),
-        }))
+        }
+        if self._peer_traced(peer):
+            # summaries always ORIGINATE here (our own maj23 bitmap claim,
+            # never a relay of someone else's summary) → hop 0
+            self._stamp_trace(fields, 0)
+        ok = await peer.send(STATE_CHANNEL, _enc("vote_summary", fields))
         if ok:
             ps.summary_sent[key] = (count, now)
             ps.prune_sent(ps.summary_sent, now, now - resend_after)
@@ -1013,15 +1158,22 @@ class ConsensusReactor(Reactor):
             if not progress:
                 await self._gossip_wait(peer, ps.data_wake, sleep)
 
-    def _part_frame(self, height: int, round_: int, part) -> bytes:
+    def _part_frame(self, height: int, round_: int, part, traced: bool = False) -> bytes:
         """The wire frame for a block_part message, encoded once per
-        (height, round, index) and shared across all peers."""
-        key = (height, round_, part.index)
+        (height, round, index, traced) and shared across all peers.  The
+        traced variant embeds trace context at FIRST encode — `ow` goes
+        stale across later sends of the cached frame (the price of the
+        encode-once move), which is why block_part hop events are excluded
+        from measured-skew estimation downstream (tracemerge)."""
+        key = (height, round_, part.index, traced)
         frame = self._part_frames.get(key)
         if frame is None:
-            frame = _enc("block_part", {
-                "height": height, "round": round_, "part": part.to_dict(),
-            })
+            fields = {"height": height, "round": round_, "part": part.to_dict()}
+            if traced:
+                self._stamp_trace(
+                    fields, self._content_hop(("part", height, round_, part.index))
+                )
+            frame = _enc("block_part", fields)
             self._part_frames[key] = frame
             while len(self._part_frames) > self._part_frames_cap:
                 self._part_frames.popitem(last=False)
@@ -1047,7 +1199,8 @@ class ConsensusReactor(Reactor):
                     if part is None:
                         continue
                     ok = await peer.send(
-                        DATA_CHANNEL, self._part_frame(height, round_, part)
+                        DATA_CHANNEL,
+                        self._part_frame(height, round_, part, self._peer_traced(peer)),
                     )
                     if not ok:
                         # send refused (mconn stopping / unknown channel):
@@ -1073,9 +1226,13 @@ class ConsensusReactor(Reactor):
         proposal = rs.proposal
         if proposal is not None and rs.height == ps.height and not ps.proposal:
             if rs.round == ps.round:
-                ok = await peer.send(
-                    DATA_CHANNEL, _enc("proposal", {"proposal": proposal.to_dict()})
-                )
+                fields = {"proposal": proposal.to_dict()}
+                if self._peer_traced(peer):
+                    self._stamp_trace(
+                        fields,
+                        self._content_hop(("prop", proposal.height, proposal.round)),
+                    )
+                ok = await peer.send(DATA_CHANNEL, _enc("proposal", fields))
                 if not ok:
                     return False
                 ps.set_has_proposal(proposal)
@@ -1143,7 +1300,8 @@ class ConsensusReactor(Reactor):
             if part is None:
                 break
             ok = await peer.send(
-                DATA_CHANNEL, self._part_frame(height, round_, part)
+                DATA_CHANNEL,
+                self._part_frame(height, round_, part, self._peer_traced(peer)),
             )
             if not ok:
                 break
@@ -1253,7 +1411,10 @@ class ConsensusReactor(Reactor):
         last_h, last_t = ps.agg_commit_sent
         if last_h == commit.height and now - last_t < self.AGG_COMMIT_RESEND_S:
             return False
-        ok = await peer.send(VOTE_CHANNEL, _enc("agg_commit", {"commit": commit.to_dict()}))
+        fields = {"commit": commit.to_dict()}
+        if self._peer_traced(peer):
+            self._stamp_trace(fields, self._content_hop(("agg", commit.height)))
+        ok = await peer.send(VOTE_CHANNEL, _enc("agg_commit", fields))
         if ok:
             ps.agg_commit_sent = (commit.height, now)
             self.cs.recorder.record(
@@ -1334,6 +1495,12 @@ class ConsensusReactor(Reactor):
                 "h": have.height, "r": have.round, "t": have.signed_msg_type,
                 "have": have.bit_array().to_bytes(),
             })
+        if included and self._peer_traced(peer):
+            # content hop = worst relay depth among the votes: own votes
+            # contribute 0 (we originate), a vote received at hop k is
+            # relayed at k+1 — so the stamp never decrements along a path
+            hop = max(getattr(v, "_trace_hop", -1) for v in included) + 1
+            self._stamp_trace(frame, min(hop, TRACE_MAX_HOP))
         ok = await peer.send(VOTE_CHANNEL, _enc("vote_batch", frame))
         if ok:
             for v in included:

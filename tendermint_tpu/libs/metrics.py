@@ -80,7 +80,7 @@ class ConsensusMetrics:
                 "block_interval_seconds", "num_txs", "block_size_bytes",
                 "total_txs", "committed_height", "fast_syncing", "block_parts",
                 "gossip_wakeups", "vote_batch_size", "parts_per_burst",
-                "vote_summaries", "vote_pulls",
+                "vote_summaries", "vote_pulls", "trace_clamps",
             ):
                 setattr(self, name, _NOP)
             return
@@ -151,6 +151,15 @@ class ConsensusMetrics:
             "vote_pulls",
             "vote_pull requests served with a targeted vote_batch.",
         )
+        # wire-level trace context (gossip_version >= 3): received frames
+        # whose hop count / origin timestamp failed the sanity clamps —
+        # byzantine or badly skewed senders; the sample is discarded from
+        # skew estimation, so this series is the only place it shows up
+        self.trace_clamps = g(
+            "trace_clamps",
+            "Received trace-context fields clamped as implausible "
+            "(hop out of range or origin timestamp outside the sanity window).",
+        )
 
 
 class P2PMetrics:
@@ -161,6 +170,8 @@ class P2PMetrics:
             self.peers = _NOP
             self.peer_receive_bytes_total = _NOP
             self.peer_send_bytes_total = _NOP
+            self.peer_pending_send_bytes = _NOP
+            self.peer_send_queue_depth = _NOP
             return
         from prometheus_client import Counter, Gauge
 
@@ -178,6 +189,30 @@ class P2PMetrics:
             "peer_send_bytes_total", "Number of bytes sent to a given peer.",
             namespace=NAMESPACE, subsystem=sub, registry=registry,
             labelnames=("chain_id", "peer_id", "chID"),
+        )
+        # Link-backpressure telemetry (no reference counterpart — the
+        # reference exposes connection COUNT, not a backed-up queue, which
+        # is the thing that actually precedes a gossip stall).  Published
+        # by the watchdog tick from live MConnection channel queues;
+        # `peer_pending_send_bytes` mirrors the reference's name for the
+        # analogous mconn gauge so dashboards can converge on it.
+        self.peer_pending_send_bytes = _BoundLabels(
+            Gauge(
+                "peer_pending_send_bytes",
+                "Bytes sitting in a peer's per-channel send queue.",
+                namespace=NAMESPACE, subsystem=sub, registry=registry,
+                labelnames=("chain_id", "peer_id", "chID"),
+            ),
+            chain_id=chain_id,
+        )
+        self.peer_send_queue_depth = _BoundLabels(
+            Gauge(
+                "peer_send_queue_depth",
+                "Frames queued (occupancy) in a peer's per-channel send queue.",
+                namespace=NAMESPACE, subsystem=sub, registry=registry,
+                labelnames=("chain_id", "peer_id", "chID"),
+            ),
+            chain_id=chain_id,
         )
 
 

@@ -19,20 +19,34 @@ idle shares).  This is what `tendermint_tpu trace-net`, `make
 trace-net-smoke` and the 100-validator rig's `block_attribution_100val`
 all run.
 
-Clock alignment is two-stage:
+Clock alignment is three-stage:
 
   1. anchors — each dump's events map to wall time via its own anchor
      (re-sampled at dump time); honest clocks land within NTP error.
-  2. causal refinement (`estimate_offsets`) — per-height commit events
-     are near-simultaneous landmarks shared by every node; each node's
-     median residual against the per-height cross-node median commit
-     time estimates its clock offset, robustly (a minority of skewed
-     clocks cannot drag the median).  The estimate deliberately folds a
-     node's *systematic* commit lag into its "offset" — separating the
-     two would need message-level one-way-delay estimation; the residual
-     skew this leaves is bounded by real commit skew, orders of magnitude
-     below the seconds-scale faults chaos/clock.py injects.  Offsets are
-     reported per node so a skewed clock is VISIBLE, not silently fixed.
+  2. causal refinement (`estimate_offsets`) — per-height landmark events
+     (commit, falling back to parts-complete then proposal for nodes
+     that joined late via fastsync and hold no commit for the shared
+     window) are near-simultaneous across nodes; each node's median
+     residual against the per-height cross-node median estimates its
+     clock offset, robustly (a minority of skewed clocks cannot drag the
+     median).  The estimate deliberately folds a node's *systematic*
+     commit lag into its "offset" — separating the two needs
+     message-level one-way-delay estimation, which is exactly what stage
+     3 adds; the residual skew this leaves is bounded by real commit
+     skew, orders of magnitude below the seconds-scale faults
+     chaos/clock.py injects.  Offsets are reported per node so a skewed
+     clock is VISIBLE, not silently fixed.
+  3. measured skew (`measured_offsets`) — when peers speak the wire
+     trace tier (gossip_version >= 3), every received frame carries the
+     sender's send-wall stamp and the receiver's `gossip.hop` events
+     record origin-vs-receive latency directly.  Per node, the median of
+     direct (hop 0, unclamped, non-block_part — their cached frames
+     carry stale stamps) latencies is one-way-delay + that node's clock
+     offset; normalizing across the fleet's medians cancels the common
+     delay term.  `merge` prefers measured offsets over landmark
+     estimates whenever a node has enough samples, and reports per-node
+     sample counts and the source of each offset so the operator can see
+     WHICH alignment each node got.
 
 Dumps may arrive out of order, overlap in wall time or cover different
 height windows — everything is keyed by height and node name, and events
@@ -123,39 +137,112 @@ def _pctl(xs: Sequence[float], q: float) -> float:
     return xs[min(len(xs) - 1, int(q * len(xs)))]
 
 
-def estimate_offsets(dumps: List[dict]) -> List[int]:
+#: Landmark kinds estimate_offsets anchors on, tried in order.  Commit is
+#: the tightest (near-simultaneous by construction); a node that joined
+#: late (fastsync) may hold NO commit event for the shared window, and its
+#: offset used to silently degrade to 0 — parts-complete and proposal
+#: events are looser landmarks but still land within a propagation delay.
+LANDMARK_KINDS = ("commit", "block.parts_complete", "proposal")
+
+#: Minimum direct-frame latency samples before merge() trusts a node's
+#: MEASURED offset over the landmark estimate.  A handful of samples is
+#: one noisy burst; eight spans several heights of traffic.
+MEASURED_MIN_SAMPLES = 8
+
+
+def estimate_offsets(dumps: List[dict], detail: bool = False):
     """Per-dump clock-offset estimate (ns, to SUBTRACT from that dump's
-    anchor-aligned wall times), from per-height commit landmarks.  Zero
-    for dumps lacking anchors or shared commit heights."""
-    commits = [_first_events(d, "commit") for d in dumps]
-    # per-height anchor-aligned commit walls across nodes
-    per_height: Dict[int, List[Optional[int]]] = {}
-    for i, cm in enumerate(commits):
-        for h, ev in cm.items():
-            w = _anchor_wall(dumps[i], ev["t_ns"])
-            if w is None:
-                continue
-            per_height.setdefault(h, [None] * len(dumps))[i] = w
-    refs: Dict[int, float] = {
-        h: _median([w for w in ws if w is not None])
-        for h, ws in per_height.items()
-        if sum(w is not None for w in ws) >= 2
-    }
-    offsets: List[int] = []
-    for i in range(len(dumps)):
-        residuals = [
-            per_height[h][i] - refs[h]
-            for h in refs
-            if per_height[h][i] is not None
-        ]
-        offsets.append(int(_median(residuals)) if residuals else 0)
+    anchor-aligned wall times), from per-height shared landmarks.  Each
+    kind in LANDMARK_KINDS is tried in order and a node keeps the FIRST
+    kind that yields any residuals, so a fastsync joiner without commits
+    falls back instead of silently getting 0.  Zero for dumps lacking
+    anchors or any shared landmark heights.
+
+    detail=True returns (offsets, samples, kinds): per-node residual
+    sample counts (0 = unaligned) and the landmark kind each node used
+    ("" = none) — merge() surfaces both."""
+    n = len(dumps)
+    offsets = [0] * n
+    samples = [0] * n
+    kinds = [""] * n
+    for kind in LANDMARK_KINDS:
+        unresolved = [i for i in range(n) if samples[i] == 0]
+        if not unresolved:
+            break
+        firsts = [_first_events(d, kind) for d in dumps]
+        # per-height anchor-aligned landmark walls across nodes
+        per_height: Dict[int, List[Optional[int]]] = {}
+        for i, fm in enumerate(firsts):
+            for h, ev in fm.items():
+                w = _anchor_wall(dumps[i], ev["t_ns"])
+                if w is None:
+                    continue
+                per_height.setdefault(h, [None] * n)[i] = w
+        refs: Dict[int, float] = {
+            h: _median([w for w in ws if w is not None])
+            for h, ws in per_height.items()
+            if sum(w is not None for w in ws) >= 2
+        }
+        for i in unresolved:
+            residuals = [
+                per_height[h][i] - refs[h]
+                for h in refs
+                if per_height[h][i] is not None
+            ]
+            if residuals:
+                offsets[i] = int(_median(residuals))
+                samples[i] = len(residuals)
+                kinds[i] = kind
+    if detail:
+        return offsets, samples, kinds
     return offsets
+
+
+def measured_offsets(dumps: List[dict]):
+    """Per-dump MEASURED clock offsets (ns) from wire-level trace context
+    (`gossip.hop` events, gossip_version >= 3).  A direct frame's latency
+    sample is receiver_wall − sender_send_wall = one-way delay + the
+    receiver's clock offset relative to the sender; the per-node median
+    over many senders is delay + that node's offset relative to the fleet,
+    and subtracting the fleet-wide median of medians cancels the common
+    delay term.  Only trustworthy samples count: lat_ms present, not
+    clamped (byzantine fields never reach here), hop == 0 (relayed frames
+    fold relay queueing into "delay"), and frame != block_part (cached
+    part frames carry stale send stamps — see reactor._part_frame).
+
+    Returns (offsets, samples); all-zero offsets when fewer than 2 nodes
+    have samples (nothing to normalize against)."""
+    n = len(dumps)
+    meds: List[Optional[float]] = [None] * n
+    samples = [0] * n
+    for i, d in enumerate(dumps):
+        lats = [
+            ev["lat_ms"]
+            for ev in d["events"]
+            if ev.get("kind") == "gossip.hop"
+            and ev.get("lat_ms") is not None
+            and not ev.get("clamped")
+            and ev.get("hop") == 0
+            and ev.get("frame") != "block_part"
+        ]
+        if lats:
+            meds[i] = _median(lats)
+            samples[i] = len(lats)
+    valid = [m for m in meds if m is not None]
+    if len(valid) < 2:
+        return [0] * n, samples
+    base = _median(valid)
+    offsets = [
+        int((m - base) * 1e6) if m is not None else 0 for m in meds
+    ]
+    return offsets, samples
 
 
 def merge(dumps: List[dict], causal: bool = True) -> dict:
     """Merge N dumps into the network timeline.  Returns
 
       {"nodes", "offsets_ms", "t0_wall_ns", "heights": {h: {...}},
+       "offset_samples", "offset_sources",
        "commit_skew_ms_p50", "commit_skew_ms_p90",
        "coverage_ms_p50", "coverage_ms_p90", "hash_mismatch_heights"}
 
@@ -168,7 +255,22 @@ def merge(dumps: List[dict], causal: bool = True) -> dict:
     names = [d.get("node", f"node{i}") for i, d in enumerate(dumps)]
     for d in dumps:
         _normalize(d)
-    offsets = estimate_offsets(dumps) if causal else [0] * len(dumps)
+    n = len(dumps)
+    offsets = [0] * n
+    offset_samples = [0] * n
+    offset_sources = ["anchor"] * n
+    if causal:
+        est, est_samples, est_kinds = estimate_offsets(dumps, detail=True)
+        meas, meas_samples = measured_offsets(dumps)
+        for i in range(n):
+            if meas_samples[i] >= MEASURED_MIN_SAMPLES:
+                offsets[i] = meas[i]
+                offset_samples[i] = meas_samples[i]
+                offset_sources[i] = "measured"
+            elif est_samples[i] > 0:
+                offsets[i] = est[i]
+                offset_samples[i] = est_samples[i]
+                offset_sources[i] = f"landmark:{est_kinds[i]}"
 
     def wall(i: int, t_ns: int) -> Optional[int]:
         w = _anchor_wall(dumps[i], t_ns)
@@ -261,6 +363,8 @@ def merge(dumps: List[dict], causal: bool = True) -> dict:
     return {
         "nodes": names,
         "offsets_ms": [round(o / 1e6, 3) for o in offsets],
+        "offset_samples": offset_samples,
+        "offset_sources": offset_sources,
         "t0_wall_ns": t0,
         "heights": out_heights,
         "commit_skew_ms_p50": round(_pctl(skews, 0.5), 3) if skews else None,
@@ -371,10 +475,16 @@ def check(dumps: List[dict], merged: dict, require_attribution: bool = True) -> 
 def format_timeline(merged: dict, heights: Optional[Sequence[int]] = None) -> str:
     """Human-readable per-height network timeline (the trace-net default
     output)."""
+    sources = merged.get("offset_sources") or [""] * len(merged["nodes"])
+    samples = merged.get("offset_samples") or [0] * len(merged["nodes"])
     lines = [
         "nodes: " + ", ".join(
-            f"{n} (offset {o:+.1f} ms)"
-            for n, o in zip(merged["nodes"], merged["offsets_ms"])
+            f"{n} (offset {o:+.1f} ms"
+            + (f", {src} n={cnt}" if src else "")
+            + ")"
+            for n, o, src, cnt in zip(
+                merged["nodes"], merged["offsets_ms"], sources, samples
+            )
         ),
     ]
     if merged.get("commit_skew_ms_p50") is not None:

@@ -36,6 +36,21 @@ Event kinds currently emitted:
     gossip.vote_batch_recv  n, dup, peer       decoded batch entered the verifier
                                                (n fresh votes, dup already-held)
     gossip.part_burst n, peer[, catchup]       block parts sent in one burst
+    gossip.hop        frame, peer, origin, hop[, h, lat_ms, clamped]
+                                               wire-level trace context decoded
+                                               off a received frame (gossip
+                                               version >= 3): per-kind
+                                               propagation latency (sender send
+                                               wall ns vs our wall ns) and the
+                                               content hop count.  `clamped=1`
+                                               marks byzantine/garbled fields
+                                               (hop out of range, origin
+                                               timestamp outside the ±60 s
+                                               sanity window) — those carry no
+                                               lat_ms and are excluded from
+                                               skew estimation.  HIGH-RATE —
+                                               subject to trace_sample_high_rate
+                                               (net_budget consumes it)
   scheduler profiler (libs/loopprof.py, [instrumentation] loop_profiler):
     loop.lag          lag_ms                   scheduled-vs-actual probe wakeup
                                                delta, once per probe interval
@@ -431,6 +446,162 @@ def format_budget(budget: Optional[dict]) -> str:
             f"  {name:<15}{st['n']:>5}{st['p50_ms']:>10.3f}"
             f"{st['p90_ms']:>10.3f}{st['max_ms']:>10.3f}"
         )
+    return "\n".join(lines)
+
+
+#: Stage names of the cross-node network budget, in dissemination order.
+#: proposal_prop = wire propagation latency of received proposal frames
+#: (origin send stamp → our receive, measured — needs gossip_version 3
+#: peers); part_stream = first sign of the block (proposal accepted or
+#: first part hop) → part set complete; vote_fanin = first vote activity
+#: for the height (Prevote entry or first vote_batch received) → Commit
+#: entry (+2/3 precommits held).
+NET_BUDGET_STAGES = ("proposal_prop", "part_stream", "vote_fanin")
+
+
+def net_budget(events: List[dict]) -> Optional[dict]:
+    """The cross-node sibling of stage_budget: from ONE node's flight
+    recorder alone, attribute where inter-node time goes per height —
+    proposal propagation, part-stream completion, vote fan-in to quorum —
+    plus per-frame-kind hop-count and propagation-latency distributions
+    from the wire-level trace context (`gossip.hop`, gossip_version >= 3).
+    The budget stages work on any net (they only need step/proposal/
+    parts_complete events); the hop/latency sections need traced peers.
+    Surfaced as `debug trace --net-budget` and folded into the smokes'
+    JSON.  None when no height has enough events for any stage."""
+    chains = step_chains(events)
+    proposal_t: dict = {}        # height -> first proposal-accepted t_ns
+    parts_done_t: dict = {}      # height -> parts_complete t_ns
+    first_part_t: dict = {}      # height -> first block_part hop t_ns
+    first_batch_t: dict = {}     # height -> first vote_batch_recv t_ns
+    hops: dict = {}              # frame kind -> [hop counts]
+    hop_lat: dict = {}           # frame kind -> [lat_ms]
+    prop_lat: dict = {}          # height -> [proposal-frame lat_ms]
+    clamped = 0
+    for ev in events:
+        k = ev.get("kind")
+        if k == "proposal":
+            proposal_t.setdefault(ev["height"], ev["t_ns"])
+        elif k == "block.parts_complete":
+            parts_done_t.setdefault(ev["height"], ev["t_ns"])
+        elif k == "gossip.vote_batch_recv":
+            h = ev.get("h")
+            if h is not None:
+                first_batch_t.setdefault(h, ev["t_ns"])
+        elif k == "gossip.hop":
+            frame = ev.get("frame", "?")
+            if ev.get("clamped"):
+                clamped += 1
+            else:
+                hops.setdefault(frame, []).append(ev.get("hop", 0))
+                lat = ev.get("lat_ms")
+                if lat is not None:
+                    hop_lat.setdefault(frame, []).append(lat)
+                    if frame == "proposal" and ev.get("h") is not None:
+                        prop_lat.setdefault(ev["h"], []).append(lat)
+            if frame == "block_part" and ev.get("h") is not None:
+                first_part_t.setdefault(ev["h"], ev["t_ns"])
+    stages: dict = {name: [] for name in NET_BUDGET_STAGES}
+    heights: List[int] = []
+    for h in sorted(set(chains) | set(parts_done_t) | set(prop_lat)):
+        used = False
+        for lat in prop_lat.get(h, ()):
+            stages["proposal_prop"].append(lat)
+            used = True
+        done = parts_done_t.get(h)
+        if done is not None:
+            starts = [t for t in (proposal_t.get(h), first_part_t.get(h)) if t is not None]
+            if starts and done >= min(starts):
+                stages["part_stream"].append((done - min(starts)) / 1e6)
+                used = True
+        steps = chains.get(h, {})
+        quorum = steps.get("Commit")
+        if quorum is not None:
+            starts = [t for t in (steps.get("Prevote"), first_batch_t.get(h)) if t is not None]
+            if starts and quorum >= min(starts):
+                stages["vote_fanin"].append((quorum - min(starts)) / 1e6)
+                used = True
+        if used:
+            heights.append(h)
+    if not heights and not hops:
+        return None
+
+    def dist(xs: List[float]) -> dict:
+        return {
+            "n": len(xs),
+            "p50": round(_pctl(xs, 0.5), 3),
+            "p90": round(_pctl(xs, 0.9), 3),
+            "max": round(max(xs), 3) if xs else 0.0,
+        }
+
+    out: dict = {
+        "source": "flight_recorder",
+        "blocks": len(heights),
+        "heights": [heights[0], heights[-1]] if heights else [],
+        "stages": {},
+        "hops": {},
+        "hop_lat_ms": {},
+        "clamped": clamped,
+    }
+    for name in NET_BUDGET_STAGES:
+        xs = stages[name]
+        if xs:
+            d = dist(xs)
+            out["stages"][name] = {
+                "n": d["n"], "p50_ms": d["p50"], "p90_ms": d["p90"], "max_ms": d["max"],
+            }
+    for frame, xs in sorted(hops.items()):
+        out["hops"][frame] = dist([float(x) for x in xs])
+    for frame, xs in sorted(hop_lat.items()):
+        out["hop_lat_ms"][frame] = dist(xs)
+    pooled = [x for xs in hop_lat.values() for x in xs]
+    if pooled:
+        # frame-agnostic propagation latency: the bench `gossip_hop_p90_ms`
+        # number and the telescope's fleet hop-latency line
+        out["hop_lat_all_ms"] = dist(pooled)
+    return out
+
+
+def format_net_budget(budget: Optional[dict]) -> str:
+    """Aligned rendering of a net_budget dict (`trace --net-budget`)."""
+    if budget is None:
+        return "no network-plane events — nothing to budget"
+    span = (
+        f" (heights {budget['heights'][0]}..{budget['heights'][1]})"
+        if budget.get("heights") else ""
+    )
+    lines = [
+        f"network budget over {budget['blocks']} blocks{span}",
+        f"  {'stage':<15}{'n':>5}{'p50 ms':>10}{'p90 ms':>10}{'max ms':>10}",
+    ]
+    for name in NET_BUDGET_STAGES:
+        st = budget["stages"].get(name)
+        if st is None:
+            continue
+        lines.append(
+            f"  {name:<15}{st['n']:>5}{st['p50_ms']:>10.3f}"
+            f"{st['p90_ms']:>10.3f}{st['max_ms']:>10.3f}"
+        )
+    if budget["hops"]:
+        lines.append(f"  {'hop counts':<15}{'n':>5}{'p50':>10}{'p90':>10}{'max':>10}")
+        for frame, d in budget["hops"].items():
+            lines.append(
+                f"  {frame:<15}{d['n']:>5}{d['p50']:>10.1f}{d['p90']:>10.1f}{d['max']:>10.1f}"
+            )
+    if budget["hop_lat_ms"]:
+        lines.append(f"  {'hop lat ms':<15}{'n':>5}{'p50':>10}{'p90':>10}{'max':>10}")
+        for frame, d in budget["hop_lat_ms"].items():
+            lines.append(
+                f"  {frame:<15}{d['n']:>5}{d['p50']:>10.3f}{d['p90']:>10.3f}{d['max']:>10.3f}"
+            )
+        d = budget.get("hop_lat_all_ms")
+        if d:
+            lines.append(
+                f"  {'(all frames)':<15}{d['n']:>5}{d['p50']:>10.3f}"
+                f"{d['p90']:>10.3f}{d['max']:>10.3f}"
+            )
+    if budget.get("clamped"):
+        lines.append(f"  clamped trace fields: {budget['clamped']} (excluded above)")
     return "\n".join(lines)
 
 

@@ -335,6 +335,14 @@ class Watchdog(Service):
                     alarms["peer_collapse"] = (
                         f"{n_peers} peers, down from peak {self._peer_peak}"
                     )
+            # link backpressure telemetry: per-peer per-channel send-queue
+            # occupancy, published from the live MConnection channels — the
+            # backed-up queue that PRECEDES a gossip stall, which the
+            # connection-count detector above cannot see
+            try:
+                self._publish_link_telemetry(sw)
+            except Exception:  # noqa: BLE001 — switch/peer mid-teardown
+                pass
 
         # verify-engine queue stall (pending timestamps are loop.time())
         av = getattr(node, "async_verifier", None)
@@ -444,6 +452,33 @@ class Watchdog(Service):
 
         self._apply(alarms, now)
         return self.health(now)
+
+    def _publish_link_telemetry(self, sw) -> None:
+        """Export each peer's per-channel send-queue occupancy as
+        `tendermint_p2p_peer_send_queue_depth` (frames) and
+        `tendermint_p2p_peer_pending_send_bytes` (queued + in-flight
+        bytes), labeled like the existing byte counters.  Gauges for a
+        departed peer simply stop updating (the scrape shows the last
+        value until restart — same staleness story as the reference's
+        per-peer counters)."""
+        p2p = getattr(getattr(self.node, "metrics_provider", None), "p2p", None)
+        if p2p is None:
+            return
+        for peer in list(getattr(sw, "peers", {}).values()):
+            mconn = getattr(peer, "mconn", None)
+            if mconn is None:
+                continue
+            for chan_id, ch in mconn.channels.items():
+                labels = {"peer_id": peer.id, "chID": str(chan_id)}
+                p2p.peer_send_queue_depth.labels(**labels).set(
+                    ch.send_queue.qsize()
+                )
+                # queued full frames plus the partially-sent remainder —
+                # the byte-accurate backlog the flow scheduler is draining
+                pending = len(ch.sending) + sum(
+                    len(m) for m in ch.send_queue._queue
+                )
+                p2p.peer_pending_send_bytes.labels(**labels).set(pending)
 
     # -- transitions -------------------------------------------------------
 

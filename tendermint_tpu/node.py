@@ -436,14 +436,24 @@ class Node(Service):
             from .mempool_reactor import MempoolReactor
             from .p2p import NodeInfo, NodeKey, Switch, Transport
 
-            from .p2p.node_info import GOSSIP_BATCH_VERSION, GOSSIP_SUMMARY_VERSION
+            from .p2p.node_info import (
+                GOSSIP_BATCH_VERSION,
+                GOSSIP_SUMMARY_VERSION,
+                GOSSIP_TRACE_VERSION,
+            )
 
             self.node_key = NodeKey.load_or_gen(cfg.node_key_file())
             # advertise the highest gossip capability the knobs enable;
-            # peers fall back per-level (2 → summary+batch, 1 → batch,
-            # 0 → the reference's single-vote messages), so mixed-version
-            # nets converge
-            if cfg.consensus.gossip_vote_batch and cfg.consensus.gossip_vote_summary:
+            # peers fall back per-level (3 → wire trace context, 2 →
+            # summary+batch, 1 → batch, 0 → the reference's single-vote
+            # messages), so mixed-version nets converge
+            if (
+                cfg.consensus.gossip_vote_batch
+                and cfg.consensus.gossip_vote_summary
+                and cfg.consensus.gossip_trace_context
+            ):
+                gossip_version = GOSSIP_TRACE_VERSION
+            elif cfg.consensus.gossip_vote_batch and cfg.consensus.gossip_vote_summary:
                 gossip_version = GOSSIP_SUMMARY_VERSION
             elif cfg.consensus.gossip_vote_batch:
                 gossip_version = GOSSIP_BATCH_VERSION

@@ -20,10 +20,16 @@ MAX_NUM_CHANNELS = 16
 # peer decodes byte-capped `vote_batch` frames on the VOTE channel; 2 =
 # the peer additionally speaks the maj23 aggregation exchange
 # (`vote_summary` on STATE, `vote_pull` on VOTE_SET_BITS) used by the
-# degree-bounded relay topology at committee scale.  Capabilities are
-# cumulative: a v2 peer accepts everything a v1 peer does.
+# degree-bounded relay topology at committee scale; 3 = the peer decodes
+# optional wire-level trace context (origin node id / origin wall ns /
+# hop count riding as extra keys on `vote` / `vote_batch` /
+# `vote_summary` / `block_part` / `proposal` / `agg_commit` frames) and
+# emits `gossip.hop` recorder events from it.  Capabilities are
+# cumulative: a v2 peer accepts everything a v1 peer does, and frames to
+# a peer below a level simply omit that level's fields.
 GOSSIP_BATCH_VERSION = 1
 GOSSIP_SUMMARY_VERSION = 2
+GOSSIP_TRACE_VERSION = 3
 
 
 @dataclass

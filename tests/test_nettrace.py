@@ -1,0 +1,557 @@
+"""Network-plane tracing tests: wire-level trace context, cross-node
+stage budgets, and the fleet telescope.
+
+The trace-context plane rides the capability ladder one level above the
+summary exchange (gossip_version >= 3) and every carried field is
+attacker-suppliable, so the contracts pinned here are:
+
+1. negotiation — frames to traced peers carry (o, ow, hp), frames to
+   older peers omit them byte-for-byte and still parse on both sides;
+2. monotone hops — a vote received at hop k relays at k+1, never less;
+3. byzantine clamps — a forged huge hop count or far-future origin
+   timestamp is clamped + counted and NEVER yields a latency sample, so
+   it can't poison tracemerge's measured skew estimation;
+4. net_budget / measured_offsets — the analysis layer computes the
+   documented stages from synthetic recorder events;
+5. telescope — the collector survives dead nodes and keeps a killed
+   node's buffered window on the merged timeline;
+6. hot path — record_sampled with trace stamping stays under the 5 µs
+   tripwire (same budget as tests/test_tracing.py).
+"""
+
+import asyncio
+import json
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from tendermint_tpu.config import ConsensusConfig
+from tendermint_tpu.consensus.reactor import (
+    TRACE_MAX_HOP,
+    VOTE_CHANNEL,
+    ConsensusReactor,
+    PeerRoundState,
+    _enc,
+)
+from tendermint_tpu.consensus.types import HeightVoteSet, RoundState
+from tendermint_tpu.crypto.batch_verifier import AsyncBatchVerifier, BatchVerifier
+from tendermint_tpu.encoding import codec
+from tendermint_tpu.libs import tracemerge, tracing
+from tendermint_tpu.libs.metrics import ConsensusMetrics
+from tendermint_tpu.p2p.node_info import GOSSIP_TRACE_VERSION, NodeInfo
+from tendermint_tpu.tools.telescope import Telescope
+from tendermint_tpu.types import (
+    BlockID,
+    MockPV,
+    Validator,
+    ValidatorSet,
+    Vote,
+)
+from tendermint_tpu.types.canonical import PREVOTE_TYPE
+
+CHAIN_ID = "nettrace-test-chain"
+
+
+# ---------------------------------------------------------------------------
+# fixtures (the test_gossip.py unit-level slice)
+# ---------------------------------------------------------------------------
+
+
+class _HostVerifier(BatchVerifier):
+    def __init__(self):
+        super().__init__(min_device_batch=10**9)  # always the host path
+
+    def start_warmup(self):
+        return self  # no background compile thread in unit tests
+
+
+class _FakeSwitch:
+    def __init__(self, node_id="ee" * 20):
+        self.node_id = node_id
+        self.stopped = []
+
+    async def stop_peer_for_error(self, peer, reason):
+        self.stopped.append((peer.id, reason))
+
+
+class _FakeCS:
+    def __init__(self, vset, height=5):
+        self.config = ConsensusConfig()
+        self.rs = RoundState(
+            height=height,
+            validators=vset,
+            votes=HeightVoteSet(CHAIN_ID, height, vset),
+            last_validators=None,
+        )
+        self.sm_state = SimpleNamespace(chain_id=CHAIN_ID)
+        self.on_new_round_step = []
+        self.on_vote = []
+        self.on_valid_block = []
+        self.on_proposal = []
+        self.on_new_block_part = []
+        self.metrics = ConsensusMetrics()
+        self.recorder = tracing.FlightRecorder(size=512)
+        self.added = []
+
+    async def add_vote_input(self, vote, peer_id="", verified=False):
+        self.added.append((vote, peer_id, verified))
+
+
+class _CapturePeer:
+    def __init__(self, pid, gossip_version=GOSSIP_TRACE_VERSION):
+        self.id = pid
+        self.gossip_version = gossip_version
+        self.sent = []
+
+    async def send(self, chan, msg):
+        d = codec.loads(msg)
+        self.sent.append((chan, d.pop("k"), d, msg))
+        return True
+
+
+def _vset_and_votes(n=4, height=5):
+    pvs = [MockPV() for _ in range(n)]
+    vset = ValidatorSet([Validator.new(pv.get_pub_key(), 10) for pv in pvs])
+    pvs.sort(key=lambda pv: pv.address())
+    votes = []
+    for pv in pvs:
+        i, _ = vset.get_by_address(pv.address())
+        v = Vote(
+            type=PREVOTE_TYPE, height=height, round=0, block_id=BlockID(),
+            timestamp_ns=1, validator_address=pv.address(), validator_index=i,
+        )
+        pv.sign_vote(CHAIN_ID, v)
+        votes.append(v)
+    return vset, votes
+
+
+def _reactor(cs, verifier=None):
+    r = ConsensusReactor(cs, async_verifier=verifier)
+    r.switch = _FakeSwitch()
+    return r
+
+
+def _hop_events(recorder):
+    return [e for e in recorder.events() if e["kind"] == "gossip.hop"]
+
+
+# ---------------------------------------------------------------------------
+# wire-level trace context
+# ---------------------------------------------------------------------------
+
+
+class TestTraceNegotiation:
+    def test_node_info_ladder(self):
+        old = NodeInfo.from_dict({"node_id": "ab" * 20})
+        assert old.gossip_version == 0
+        assert GOSSIP_TRACE_VERSION == 3
+
+    async def test_batch_to_traced_peer_is_stamped_and_to_old_peer_is_not(self):
+        vset, votes = _vset_and_votes(4)
+        cs = _FakeCS(vset)
+        reactor = _reactor(cs)
+        traced = _CapturePeer("aa" * 20, gossip_version=GOSSIP_TRACE_VERSION)
+        legacy = _CapturePeer("bb" * 20, gossip_version=2)
+        await reactor._send_vote_batch(traced, PeerRoundState(), votes, 4)
+        await reactor._send_vote_batch(legacy, PeerRoundState(), votes, 4)
+        _, kind, d, _ = traced.sent[0]
+        assert kind == "vote_batch"
+        # own votes: no stored hop -> the stamp originates at hop 0
+        assert d["hp"] == 0
+        assert d["o"] == reactor._trace_origin_id() and len(d["o"]) == 16
+        assert isinstance(d["ow"], int) and d["ow"] > 0
+        _, kind, d2, _ = legacy.sent[0]
+        assert kind == "vote_batch"
+        assert "o" not in d2 and "ow" not in d2 and "hp" not in d2
+
+    async def test_knob_off_suppresses_stamping(self):
+        vset, votes = _vset_and_votes(4)
+        cs = _FakeCS(vset)
+        cs.config.gossip_trace_context = False
+        reactor = _reactor(cs)
+        peer = _CapturePeer("aa" * 20)
+        await reactor._send_vote_batch(peer, PeerRoundState(), votes, 4)
+        assert "ow" not in peer.sent[0][2]
+
+    async def test_untraced_frame_parses_unchanged(self):
+        """Frames without trace fields (an old sender) must land votes
+        exactly as before and emit NO gossip.hop event."""
+        vset, votes = _vset_and_votes(4)
+        cs = _FakeCS(vset)
+        svc = AsyncBatchVerifier(_HostVerifier())
+        await svc.start()
+        try:
+            reactor = _reactor(cs, svc)
+            peer = SimpleNamespace(id="old-peer-000000", gossip_version=1)
+            reactor.peer_states[peer.id] = PeerRoundState()
+            msg = _enc("vote_batch", {"votes": [v.wire() for v in votes]})
+            await reactor.receive(VOTE_CHANNEL, peer, msg)
+            assert len(cs.added) == len(votes)
+            assert all(verified for _, _, verified in cs.added)
+            assert _hop_events(cs.recorder) == []
+        finally:
+            await svc.stop()
+
+
+class TestHopMonotone:
+    async def test_received_hop_relays_plus_one(self):
+        """A batch received at hop 3 emits a gossip.hop sample and is
+        relayed at hop 4 — the count never decrements along a path."""
+        vset, votes = _vset_and_votes(4)
+        cs = _FakeCS(vset)
+        svc = AsyncBatchVerifier(_HostVerifier())
+        await svc.start()
+        try:
+            reactor = _reactor(cs, svc)
+            # the sender never advertised tracing (v1) yet stamps fields:
+            # receivers honour the content, not the handshake
+            peer = SimpleNamespace(id="relay-peer-0000", gossip_version=1)
+            reactor.peer_states[peer.id] = PeerRoundState()
+            msg = _enc("vote_batch", {
+                "votes": [v.wire() for v in votes],
+                "o": "cafe" * 4, "ow": time.time_ns(), "hp": 3,
+            })
+            await reactor.receive(VOTE_CHANNEL, peer, msg)
+            assert len(cs.added) == len(votes)
+            landed = [v for v, _, _ in cs.added]
+            assert all(getattr(v, "_trace_hop", None) == 3 for v in landed)
+            (ev,) = _hop_events(cs.recorder)
+            assert ev["frame"] == "vote_batch" and ev["hop"] == 3
+            assert ev["origin"] == "cafe" * 2  # 8-char prefix
+            assert "lat_ms" in ev and "clamped" not in ev
+            assert ev["h"] == 5
+
+            out = _CapturePeer("cc" * 20)
+            await reactor._send_vote_batch(out, PeerRoundState(), landed, 4)
+            assert out.sent[0][2]["hp"] == 4
+        finally:
+            await svc.stop()
+
+    def test_hop_cap_on_relay(self):
+        vset, votes = _vset_and_votes(1)
+        reactor = _reactor(_FakeCS(vset))
+        votes[0]._trace_hop = TRACE_MAX_HOP  # already at the ceiling
+        peer = _CapturePeer("dd" * 20)
+        asyncio.run(reactor._send_vote_batch(peer, PeerRoundState(), votes, 1))
+        assert peer.sent[0][2]["hp"] == TRACE_MAX_HOP
+
+
+class TestByzantineClamps:
+    def _r(self):
+        vset, _ = _vset_and_votes(1)
+        return _reactor(_FakeCS(vset))
+
+    def _peer(self):
+        return SimpleNamespace(id="byzantine-peer0", gossip_version=1)
+
+    def test_huge_hop_clamped_and_counted(self):
+        r = self._r()
+        hp = r._trace_recv(
+            "vote", self._peer(),
+            {"o": "twin-forged-origin", "ow": time.time_ns(), "hp": 1 << 20},
+            5,
+        )
+        assert hp == TRACE_MAX_HOP
+        (ev,) = _hop_events(r.cs.recorder)
+        assert ev["clamped"] == 1 and "lat_ms" not in ev
+        assert r.trace_clamps == 1
+
+    def test_far_future_origin_clamped(self):
+        r = self._r()
+        forged = time.time_ns() + 600 * 1_000_000_000
+        hp = r._trace_recv("vote", self._peer(), {"ow": forged, "hp": 0}, 5)
+        assert hp == 0
+        (ev,) = _hop_events(r.cs.recorder)
+        assert ev["clamped"] == 1 and "lat_ms" not in ev
+
+    def test_negative_and_bool_hops_clamped_to_zero(self):
+        r = self._r()
+        assert r._trace_recv("vote", self._peer(), {"ow": time.time_ns(), "hp": -7}, 5) == 0
+        assert r._trace_recv("vote", self._peer(), {"ow": time.time_ns(), "hp": True}, 5) == 0
+        assert all(ev["clamped"] == 1 for ev in _hop_events(r.cs.recorder))
+
+    def test_missing_or_malformed_ow_means_no_context(self):
+        r = self._r()
+        assert r._trace_recv("vote", self._peer(), {"hp": 3}, 5) is None
+        assert r._trace_recv("vote", self._peer(), {"ow": "yesterday"}, 5) is None
+        assert r._trace_recv("vote", self._peer(), {"ow": True}, 5) is None
+        assert _hop_events(r.cs.recorder) == []
+
+    def test_non_string_origin_and_events_stay_json_safe(self):
+        r = self._r()
+        r._trace_recv(
+            "vote", self._peer(),
+            {"o": b"\xff" * 32, "ow": time.time_ns(), "hp": 1}, 5,
+        )
+        (ev,) = _hop_events(r.cs.recorder)
+        assert ev["origin"] == ""
+        json.dumps(ev)  # dump_flight_recorder must be able to serve it
+
+    def test_clamped_sample_never_reaches_skew_estimation(self):
+        """The end-to-end byzantine property: a forged frame's latency
+        must not move measured_offsets, however extreme the forgery."""
+        r = self._r()
+        now = time.time_ns()
+        for _ in range(20):  # honest direct traffic, ~zero latency
+            r._trace_recv("vote", self._peer(), {"ow": now, "hp": 0}, 5)
+            now = time.time_ns()
+        honest = tracemerge.measured_offsets(self._two_dumps(r))[0]
+        for _ in range(50):  # a flood of far-future forgeries
+            r._trace_recv(
+                "vote", self._peer(),
+                {"ow": time.time_ns() + 599 * 10**9, "hp": 1 << 30}, 5,
+            )
+        forged = tracemerge.measured_offsets(self._two_dumps(r))[0]
+        assert forged == honest  # byte-identical offsets: forgeries ignored
+
+    @staticmethod
+    def _two_dumps(r):
+        d = r.cs.recorder.snapshot()
+        peer = {
+            "node": "peer", "anchor": dict(d["anchor"]),
+            "events": [
+                {"kind": "gossip.hop", "hop": 0, "lat_ms": 0.1, "frame": "vote",
+                 "t_ns": i} for i in range(10)
+            ],
+        }
+        return [d, peer]
+
+
+# ---------------------------------------------------------------------------
+# net_budget
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_height_events(h, base_ns):
+    ms = 1_000_000
+    t = base_ns
+    return [
+        {"kind": "step", "height": h, "step": "Propose", "t_ns": t},
+        {"kind": "proposal", "height": h, "t_ns": t + 1 * ms},
+        {"kind": "gossip.hop", "frame": "proposal", "hop": 0, "h": h,
+         "lat_ms": 5.0, "t_ns": t + 1 * ms},
+        {"kind": "gossip.hop", "frame": "block_part", "hop": 1, "h": h,
+         "lat_ms": 2.0, "t_ns": t + 2 * ms},
+        {"kind": "block.parts_complete", "height": h, "t_ns": t + 10 * ms},
+        {"kind": "step", "height": h, "step": "Prevote", "t_ns": t + 12 * ms},
+        {"kind": "gossip.vote_batch_recv", "h": h, "t_ns": t + 13 * ms},
+        {"kind": "step", "height": h, "step": "Precommit", "t_ns": t + 20 * ms},
+        {"kind": "step", "height": h, "step": "Commit", "t_ns": t + 30 * ms},
+        {"kind": "commit", "height": h, "block": "ab" * 4, "t_ns": t + 30 * ms},
+    ]
+
+
+class TestNetBudget:
+    def test_empty_is_none(self):
+        assert tracing.net_budget([]) is None
+
+    def test_stages_from_synthetic_heights(self):
+        events = []
+        for i, h in enumerate((10, 11, 12)):
+            events += _synthetic_height_events(h, i * 100_000_000)
+        b = tracing.net_budget(events)
+        assert b["blocks"] == 3 and b["heights"] == [10, 12]
+        # proposal_prop is the proposal frame's measured latency
+        assert b["stages"]["proposal_prop"]["p50_ms"] == 5.0
+        # part_stream: earliest of proposal accept (t+1ms) and first
+        # block_part hop (t+2ms) -> parts_complete (t+10ms)
+        assert b["stages"]["part_stream"]["p50_ms"] == pytest.approx(9.0)
+        # vote_fanin: Prevote entry (t+12ms) -> Commit entry (t+30ms)
+        assert b["stages"]["vote_fanin"]["p50_ms"] == pytest.approx(18.0)
+        assert b["hops"]["proposal"]["n"] == 3
+        assert b["hop_lat_ms"]["block_part"]["p50"] == 2.0
+        assert b["hop_lat_all_ms"]["n"] == 6  # pooled across frame kinds
+        assert b["clamped"] == 0
+
+    def test_clamped_events_counted_not_measured(self):
+        events = _synthetic_height_events(7, 0)
+        events.append({"kind": "gossip.hop", "frame": "vote", "hop": 64,
+                       "clamped": 1, "t_ns": 999})
+        b = tracing.net_budget(events)
+        assert b["clamped"] == 1
+        assert "vote" not in b["hops"]  # clamped sample excluded everywhere
+
+    def test_format_is_printable(self):
+        events = _synthetic_height_events(7, 0)
+        text = tracing.format_net_budget(tracing.net_budget(events))
+        assert "vote_fanin" in text and "all frames" in text
+
+
+# ---------------------------------------------------------------------------
+# tracemerge: measured skew + landmark fallback
+# ---------------------------------------------------------------------------
+
+
+def _dump(name, wall_offset_ns=0, events=(), anchor_mono=0):
+    return {
+        "node": name,
+        "anchor": {"mono_ns": anchor_mono, "wall_ns": 1_000_000_000_000 + wall_offset_ns},
+        "events": list(events),
+    }
+
+
+def _hop(lat_ms, hop=0, frame="vote_batch", t_ns=0, clamped=False):
+    ev = {"kind": "gossip.hop", "frame": frame, "hop": hop,
+          "lat_ms": lat_ms, "t_ns": t_ns}
+    if clamped:
+        ev["clamped"] = 1
+        del ev["lat_ms"]
+    return ev
+
+
+class TestMeasuredOffsets:
+    def test_median_latency_normalized_across_fleet(self):
+        a = _dump("a", events=[_hop(10.0, t_ns=i) for i in range(9)])
+        b = _dump("b", events=[_hop(30.0, t_ns=i) for i in range(9)])
+        offsets, samples = tracemerge.measured_offsets([a, b])
+        assert samples == [9, 9]
+        # base = median([10, 30]) = 20 -> a is 10 ms fast, b 10 ms slow
+        assert offsets == [-10_000_000, 10_000_000]
+
+    def test_untrustworthy_samples_filtered(self):
+        tainted = [
+            _hop(500.0, hop=2),              # relayed: queueing, not skew
+            _hop(500.0, frame="block_part"), # cached frame: stale stamp
+            _hop(500.0, clamped=True),       # byzantine
+            {"kind": "gossip.hop", "frame": "vote", "hop": 0, "t_ns": 0},  # no lat
+        ]
+        a = _dump("a", events=[_hop(10.0, t_ns=i) for i in range(9)] + tainted)
+        b = _dump("b", events=[_hop(10.0, t_ns=i) for i in range(9)])
+        offsets, samples = tracemerge.measured_offsets([a, b])
+        assert samples == [9, 9] and offsets == [0, 0]
+
+    def test_single_node_has_nothing_to_normalize_against(self):
+        a = _dump("a", events=[_hop(10.0) for _ in range(9)])
+        b = _dump("b")
+        offsets, samples = tracemerge.measured_offsets([a, b])
+        assert offsets == [0, 0] and samples == [9, 0]
+
+
+class TestLandmarkFallback:
+    def _commit(self, h, t_ns):
+        return {"kind": "commit", "height": h, "block": "cd" * 4, "t_ns": t_ns}
+
+    def _proposal(self, h, t_ns):
+        return {"kind": "proposal", "height": h, "t_ns": t_ns}
+
+    def test_fastsync_joiner_falls_back_to_proposal_landmarks(self):
+        """A node whose window holds NO commits (late fastsync joiner)
+        used to silently keep offset 0 — it must now align on the looser
+        proposal landmark and report its sample count."""
+        ms = 1_000_000
+        shared = [(h, h * 100 * ms) for h in (3, 4, 5)]
+        a = _dump("a", events=[self._commit(h, t) for h, t in shared]
+                  + [self._proposal(h, t - 10 * ms) for h, t in shared])
+        b = _dump("b", events=[self._commit(h, t) for h, t in shared]
+                  + [self._proposal(h, t - 10 * ms) for h, t in shared])
+        # the joiner: same proposal walls but shifted 50 ms by clock skew,
+        # and no commit events at all
+        skew = 50 * ms
+        c = _dump("c", wall_offset_ns=skew,
+                  events=[self._proposal(h, t - 10 * ms) for h, t in shared])
+        offsets, samples, kinds = tracemerge.estimate_offsets([a, b, c], detail=True)
+        assert kinds[:2] == ["commit", "commit"]
+        assert kinds[2] == "proposal" and samples[2] == 3
+        assert offsets[2] == pytest.approx(skew, abs=2 * ms)
+
+    def test_merge_reports_sources_and_prefers_measured(self):
+        ms = 1_000_000
+        shared = [(h, h * 100 * ms) for h in (3, 4, 5)]
+        commits = [self._commit(h, t) for h, t in shared]
+        a = _dump("a", events=commits + [_hop(10.0, t_ns=i) for i in range(8)])
+        b = _dump("b", events=commits + [_hop(30.0, t_ns=i) for i in range(8)])
+        c = _dump("c", events=list(commits) + [_hop(20.0, t_ns=0)])  # < 8 samples
+        merged = tracemerge.merge([a, b, c])
+        assert merged["offset_sources"] == ["measured", "measured", "landmark:commit"]
+        assert merged["offset_samples"][0] == 8 and merged["offset_samples"][2] >= 1
+        assert merged["offsets_ms"][0] == pytest.approx(-10.0)
+        assert merged["offsets_ms"][1] == pytest.approx(10.0)
+        assert 3 in merged["heights"] and 5 in merged["heights"]
+        tracemerge.format_timeline(merged)  # renders with source annotations
+
+
+# ---------------------------------------------------------------------------
+# telescope
+# ---------------------------------------------------------------------------
+
+
+class TestTelescope:
+    def test_dead_target_flips_down_but_snapshot_survives(self):
+        t = Telescope(["127.0.0.1:1"], interval=0.01)
+        asyncio.run(t.poll_once())
+        assert t.scopes[0].alive is False and t.scopes[0].failures == 1
+        snap = t.snapshot()
+        assert snap["fleet"]["alive"] == 0 and snap["fleet"]["total"] == 1
+        json.dumps(snap)
+        assert "DOWN" in t.render(snap)
+
+    def test_killed_node_keeps_its_window_on_the_merged_timeline(self):
+        """The SIGKILL acceptance property in miniature: scope b's RPC is
+        gone (alive=False) but its buffered events still merge, with a
+        measured-skew offset source when its samples suffice."""
+        ms = 1_000_000
+        shared = [(h, h * 100 * ms) for h in (3, 4, 5)]
+        commits = [
+            {"kind": "commit", "height": h, "block": "ef" * 4, "t_ns": t}
+            for h, t in shared
+        ]
+        t = Telescope(["a:26657", "b:26657"], interval=0.01)
+        for scope, lat in zip(t.scopes, (10.0, 30.0)):
+            scope.name = scope.target[0]
+            scope.anchor = {"mono_ns": 0, "wall_ns": 10**12}
+            scope.events = commits + [_hop(lat, t_ns=i) for i in range(9)]
+            scope.height = 5
+        t.scopes[0].alive = True
+        t.scopes[1].alive = False  # SIGKILLed mid-run
+        snap = t.snapshot()
+        assert snap["fleet"]["alive"] == 1
+        assert snap["merged"]["offset_sources"] == ["measured", "measured"]
+        names = [n["name"] for n in snap["nodes"]]
+        assert names == ["a", "b"]
+        dead = snap["nodes"][1]
+        assert dead["alive"] is False and dead["events_buffered"] > 0
+        assert dead["net_budget"]["hops"]  # per-node budget still computed
+        out = t.render(snap)
+        assert "DOWN" in out and "measured" in out
+        json.dumps(snap)
+
+    def test_window_bounds_buffer(self):
+        t = Telescope(["a:26657"], window=10)
+        s = t.scopes[0]
+        s.anchor = {"mono_ns": 0, "wall_ns": 10**12}
+        # simulate what _poll_node does on fresh events past the window
+        s.events = [{"kind": "x", "t_ns": i} for i in range(25)]
+        if len(s.events) > t.window:
+            del s.events[: len(s.events) - t.window]
+        assert len(s.events) == 10 and s.events[0]["t_ns"] == 15
+
+
+# ---------------------------------------------------------------------------
+# hot path
+# ---------------------------------------------------------------------------
+
+
+class TestTraceHotPath:
+    def test_record_sampled_with_trace_fields_under_tripwire(self):
+        """gossip.hop stays off the recorder hot path: stamping the full
+        trace field set must hold the same <5 µs/event budget
+        tests/test_tracing.py pins for bare record()."""
+        r = tracing.FlightRecorder(size=4096)
+        n = 50_000
+        t0 = time.perf_counter()
+        for i in range(n):
+            r.record_sampled(
+                "gossip.hop", frame="vote_batch", peer="ab" * 4,
+                origin="cd" * 4, hop=1, h=i, lat_ms=1.234,
+            )
+        per_event = (time.perf_counter() - t0) / n
+        assert per_event < 5e-6, f"gossip.hop record cost {per_event * 1e6:.2f}us"
+
+    def test_sampling_knob_thins_events(self):
+        r = tracing.FlightRecorder(size=4096, sample_high_rate=8)
+        for i in range(64):
+            r.record_sampled("gossip.hop", hop=0, h=i)
+        evs = [e for e in r.events() if e["kind"] == "gossip.hop"]
+        assert len(evs) == 8
+        assert all(e.get("sampled") == 8 for e in evs)
