@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench bench-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke disk-smoke scale-smoke bls-smoke bls-ext load-smoke forensics-smoke finality-smoke localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke mesh-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke disk-smoke scale-smoke bls-smoke bls-ext load-smoke forensics-smoke finality-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -14,6 +14,9 @@ bench:           ## BASELINE benchmarks on the attached chip -> one JSON line
 
 bench-smoke:     ## small-batch engine regression tripwire (~1 min, asserts budgets)
 	$(PY) bench.py --smoke
+
+mesh-smoke:      ## sharded verify engine over 8 virtual CPU devices: bit-identical verdicts vs single-device, live node must route commit verifies sharded, scaling ratio reported
+	$(PY) networks/local/mesh_smoke.py --json
 
 trace-smoke:     ## short localnet; fails unless every block has a complete propose→commit span chain
 	rm -rf build-trace

@@ -63,11 +63,15 @@ def bench_primary(n_vals: int = 10_000):
     ]
     sigs = [k.sign(m) for k, m in zip(keys, msgs)]
 
-    table = PubkeyTable(pubkeys, BatchVerifier())  # tabulated auto on TPU
+    table = PubkeyTable(pubkeys, BatchVerifier())  # tabulated auto-profiled on TPU
     idxs = list(range(n_vals))
+    # Resolve the tabulated auto-profile up front (on a TPU backend this
+    # times both kernels once, building the window tables along the way) so
+    # the warm runs below measure the path the engine actually selected;
+    # the one-time resolve+build cost is what table_build_ms reports.
     table_build_ms = 0.0
-    if table.tabulated:
-        t0 = time.perf_counter()
+    t0 = time.perf_counter()
+    if table._tabulated_active(n_vals):
         table.build_tables()
         table_build_ms = (time.perf_counter() - t0) * 1000
     ok = table.verify_indexed(idxs, msgs, sigs)  # warmup/compile
@@ -129,7 +133,12 @@ def bench_primary(n_vals: int = 10_000):
             np.concatenate([np.asarray(idxs, np.int32), np.zeros(b - n_vals, np.int32)]),
             0, n_vals - 1,
         )
-        dev = [jax.device_put(a) for a in (idx_arr, h2, s2, ry2, rs2)]
+        # the fused dispatch ships packed 32 B/scalar h and s (expanded
+        # in-kernel) — device arrays here must match that wire format
+        dev = [
+            jax.device_put(a)
+            for a in (idx_arr, bv._pack_digits(h2), bv._pack_digits(s2), ry2, rs2)
+        ]
         fn = table._fused()
         np.asarray(fn(table.neg_a_rows, *dev))
         t0 = time.perf_counter()
@@ -438,6 +447,30 @@ def bench_scale_100val():
     )
     if run.returncode != 0:
         raise RuntimeError(f"scale smoke failed:\n{run.stdout[-2000:]}\n{run.stderr[-2000:]}")
+    return json.loads(run.stdout.strip().splitlines()[-1])
+
+
+def bench_mesh_scaling():
+    """Sharded verify engine over 8 virtual CPU devices
+    (networks/local/mesh_smoke.py): bit-identical verdicts vs the
+    single-device path asserted (mixed batches, ragged sizes, chunked),
+    a live solo node asserted to route commit verifies through the
+    sharded path, and throughput of both paths measured.  Reports
+    `sharded_sigs_per_sec` and `mesh_scaling_ratio` (speedup ÷ shards —
+    the >= 0.7 acceptance gate applies on real multi-chip hardware; 8
+    virtual CPU devices share this host's cores, so here the ratio is
+    reported, not gated)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    run = subprocess.run(
+        [sys.executable, os.path.join(repo, "networks", "local", "mesh_smoke.py"),
+         "--json"],
+        capture_output=True, text=True, timeout=1800, cwd=repo,
+    )
+    if run.returncode != 0:
+        raise RuntimeError(f"mesh smoke failed:\n{run.stdout[-2000:]}\n{run.stderr[-2000:]}")
     return json.loads(run.stdout.strip().splitlines()[-1])
 
 
@@ -946,6 +979,10 @@ def main() -> None:
     except Exception as e:
         load = {"tx_ingress_sustained_tps": -1.0, "error": str(e)[:300]}
     try:
+        mesh = bench_mesh_scaling()
+    except Exception as e:
+        mesh = {"sharded_sigs_per_sec": -1.0, "error": str(e)[:300]}
+    try:
         forensics = bench_forensics()
     except Exception as e:
         forensics = {"crash_bundle_completeness": -1.0, "error": str(e)[:300]}
@@ -985,6 +1022,11 @@ def main() -> None:
         "host_serial_sigs_per_sec": round(primary["host_serial_sigs_per_sec"], 1),
         "tabulated_kernel": primary["tabulated_kernel"],
         "table_build_ms": round(primary["table_build_ms"], 1),
+        "verify_shards": mesh.get("verify_shards"),
+        "sharded_sigs_per_sec": mesh.get("sharded_sigs_per_sec", -1.0),
+        "mesh_scaling_ratio": mesh.get("mesh_scaling_ratio", -1.0),
+        "mesh_speedup_x": mesh.get("mesh_speedup_x"),
+        "live_node_sharded_path": mesh.get("live_node_sharded_path"),
         "e2e_commits_per_sec_4val_procs": round(procs.get("commits_per_sec", -1.0), 2),
         "e2e_4val_procs_startup_s": procs.get("startup_s"),
         "statesync_bootstrap_ms": statesync.get("statesync_bootstrap_ms", -1.0),

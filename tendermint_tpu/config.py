@@ -305,8 +305,25 @@ class TPUConfig:
     flush_min: float = 0.0002  # adaptive quiet-window floor (seconds)
     flush_adaptive: bool = True  # arrival-rate-adaptive flush quantum
     max_batch: int = 4096
-    mesh_devices: int = 0  # 0 = single device; N>1 shards the batch axis
+    # Mesh policy for sharding the verify batch axis across devices:
+    #   "auto" — shard whenever >1 real accelerator device is visible
+    #            (virtual/host CPU device counts are ignored so forcing
+    #            XLA_FLAGS host device counts in tests doesn't silently
+    #            shard every node);
+    #   "on"   — shard over whatever devices exist, any platform (smokes,
+    #            dryruns, CPU-mesh CI);
+    #   "off"  — never shard.
+    mesh: str = "auto"
+    mesh_devices: int = 0  # 0 = use all visible; N caps the shard count
     min_device_batch: int = 16  # below this, serial host verify wins
+    # Double-buffered single-shot chunking (large indexed commits):
+    # chunk_size 0 = engine default (2048); chunk_depth bounds how many
+    # donated chunks may be in flight ahead of the device.
+    chunk_size: int = 0
+    chunk_depth: int = 2
+    # Tabulated zero-doubling kernel: "auto" profiles break-even once per
+    # process and engages only where it wins; "on"/"off" force it.
+    tabulated: str = "auto"
     # Route BLS multi-point aggregation (Σpk / Σsig of aggregate commits)
     # through the batched JAX tier (crypto/bls/jax_tier).  OFF by default:
     # on CPU-only hosts the pure-python fold wins below committee scale
@@ -587,6 +604,18 @@ class Config:
             raise ValueError("chaos.twin requires chaos.enabled")
         if self.chaos.clock_skew != 0.0 and not self.chaos.enabled:
             raise ValueError("chaos.clock_skew requires chaos.enabled")
+        if self.tpu.mesh not in ("auto", "on", "off"):
+            raise ValueError(f"unknown tpu.mesh {self.tpu.mesh!r} (want auto|on|off)")
+        if self.tpu.mesh_devices < 0:
+            raise ValueError("tpu.mesh_devices can't be negative")
+        if self.tpu.chunk_size < 0:
+            raise ValueError("tpu.chunk_size can't be negative")
+        if self.tpu.chunk_depth < 1:
+            raise ValueError("tpu.chunk_depth must be >= 1")
+        if self.tpu.tabulated not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown tpu.tabulated {self.tpu.tabulated!r} (want auto|on|off)"
+            )
         if self.storage.integrity_scan_limit < 0:
             raise ValueError("storage.integrity_scan_limit can't be negative")
         if self.storage.min_free_bytes < 0:

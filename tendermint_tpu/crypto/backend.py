@@ -82,6 +82,59 @@ def active_tier() -> int:
 
 
 # --------------------------------------------------------------------------
+# device mesh probe (the batch engine's scale axis)
+# --------------------------------------------------------------------------
+
+
+def resolve_mesh(
+    mode: str = "auto", max_devices: int = 0, batch_axis: str = "batch"
+) -> Tuple[object, int, str]:
+    """Probe the visible accelerator devices and decide the verify engine's
+    mesh.  Returns (mesh_or_None, shard_count, reason) — the same triple the
+    node logs at start and exports as `tendermint_verify_shards`, so every
+    engine number is attributable to the mesh that produced it.
+
+    Modes ([tpu] mesh):
+      "auto" — shard over all visible devices when more than one is
+               attached, EXCEPT on the host-CPU platform: virtual CPU
+               devices (xla_force_host_platform_device_count) emulate a
+               mesh for tests/dryruns but lose on real workloads unless
+               the host has cores to back them.  Setting mesh_devices > 1
+               opts virtual-CPU meshes in (back-compat with the old
+               explicit knob).
+      "on"   — shard whenever >1 device is visible, any platform (the
+               dryrun/smoke setting).
+      "off"  — never shard.
+
+    `max_devices` (tpu.mesh_devices) caps the shard count; 0 = all visible.
+    Any probe failure degrades to single-device with the failure in the
+    reason string — a broken device plane must never stop the node (the
+    host path still verifies)."""
+    if mode == "off":
+        return None, 1, "mesh off (config)"
+    try:
+        import jax
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        backend = jax.default_backend()
+        cap = max_devices if max_devices > 0 else len(devs)
+        cap = min(cap, len(devs))
+        if cap <= 1:
+            return None, 1, f"single device ({len(devs)} visible, {backend})"
+        if mode == "auto" and backend == "cpu" and max_devices <= 1:
+            return None, 1, (
+                f"{len(devs)} virtual cpu devices ignored by mesh=auto "
+                "(set mesh=on or mesh_devices to shard)"
+            )
+        mesh = Mesh(_np.array(devs[:cap]), (batch_axis,))
+        return mesh, cap, f"sharded over {cap}/{len(devs)} {backend} devices"
+    except Exception as e:  # probe failure: the host path must still serve
+        return None, 1, f"mesh probe failed: {e!r}"
+
+
+# --------------------------------------------------------------------------
 # ed25519
 # --------------------------------------------------------------------------
 

@@ -105,6 +105,16 @@ def _msb_digits(values_le: np.ndarray) -> np.ndarray:
     return dig[:, ::-1]
 
 
+def _pack_digits(digits: np.ndarray) -> np.ndarray:
+    """[B, 64] 4-bit MSB-first window digits -> [B, 32] little-endian scalar
+    bytes — inverse of _msb_digits, exact (digits are 4-bit).  The fused
+    indexed dispatch ships this packed form and expands on-device
+    (ops/ed25519.expand_digits): half the h/s transfer per signature, which
+    is the dominant single-shot cost on remote-attached devices."""
+    rev = digits[:, ::-1]
+    return (rev[:, 0::2] | (rev[:, 1::2].astype(np.uint8) << 4)).astype(np.uint8)
+
+
 def _r_limbs_and_sign(r_bytes: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """[B, 32] little-endian R rows -> raw y limbs [B, 20] + sign bit [B]."""
     from . import hostprep
@@ -206,6 +216,20 @@ def prepare_batch(
 _PALLAS_TILE = 512  # best-measured batch tile (sublane 20 x lane 512 blocks)
 _CHUNK = 2048  # double-buffer chunk for large single-shot indexed batches
 
+# One break-even profile per process (keyed by jax backend): does the
+# tabulated zero-doubling kernel beat the ladder at commit shapes?  See
+# PubkeyTable._auto_tabulated.
+_tabulated_verdict: Dict[str, bool] = {}
+_tabulated_lock = _threading.Lock()
+
+
+def _timed(fn) -> float:
+    import time as _time
+
+    t0 = _time.perf_counter()
+    fn()
+    return (_time.perf_counter() - t0) * 1000
+
 # Process-wide jit wrappers, shared across BatchVerifier/PubkeyTable
 # instances.  jax.jit memoizes traces per WRAPPER object: a per-instance
 # wrapper re-traces (and re-lowers) every bucket shape for every new
@@ -259,9 +283,20 @@ def _shared_pallas_fn(tile: int):
     return fn
 
 
-def _shared_fused_jit(inner):
+def _shared_fused_jit(inner, mesh=None, batch_axis: str = "batch"):
     """Fused gather+verify wrapper, one per inner verify wrapper (which is
-    itself process-wide) — same per-instance re-trace trap as above."""
+    itself process-wide) — same per-instance re-trace trap as above.
+
+    Wire format: h/s arrive as PACKED 32-byte little-endian scalars and are
+    expanded to window digits on-device (ops/ed25519.expand_digits) — half
+    the per-signature scalar transfer, exactly round-trippable.
+
+    With a mesh the wrapper is itself the sharded dispatch: pubkey rows
+    replicated (the HBM-resident table lives on every chip), per-signature
+    arrays partitioned over the batch axis, output partitioned the same way.
+    The gather then runs shard-local — GSPMD needs no collectives because
+    every device holds the full table.  This is the jit the warmup path
+    compiles, so the first real sharded dispatch never eats the compile."""
     key = ("fused", id(inner))
     with _shared_jit_lock:
         fn = _shared_jit.get(key)
@@ -269,10 +304,75 @@ def _shared_fused_jit(inner):
             import jax
             import jax.numpy as jnp
 
-            def run(rows, idx, h, s, ry, rs):
-                return inner(jnp.take(rows, idx, axis=0), h, s, ry, rs)
+            from ..ops import ed25519_kernel
 
-            fn = jax.jit(run)
+            def run(rows, idx, h_le, s_le, ry, rs):
+                return inner(
+                    jnp.take(rows, idx, axis=0),
+                    ed25519_kernel.expand_digits(h_le),
+                    ed25519_kernel.expand_digits(s_le),
+                    ry,
+                    rs,
+                )
+
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(mesh, P())
+                data = NamedSharding(mesh, P(batch_axis))
+                fn = jax.jit(
+                    run,
+                    in_shardings=(repl, data, data, data, data, data),
+                    out_shardings=data,
+                )
+            else:
+                fn = jax.jit(run)
+            _shared_jit[key] = fn
+    return fn
+
+
+def _shared_chunked_jit(inner, mesh=None, batch_axis: str = "batch"):
+    """The double-buffered single-shot path's per-chunk dispatch: same
+    fused gather+verify as _shared_fused_jit but with the per-signature
+    arrays DONATED — every chunk ships fresh host-prepped buffers, so the
+    device reuses their allocation instead of growing the arena one chunk
+    at a time.  Donation is NOT safe on the shared fused jit above (bench
+    and steady-state callers legitimately re-dispatch the same device
+    arrays); it lives only here, where the call contract is fresh arrays
+    per chunk.  CPU backends ignore donation (and warn per call), so it is
+    requested only off-CPU."""
+    key = ("chunk", id(inner))
+    with _shared_jit_lock:
+        fn = _shared_jit.get(key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            from ..ops import ed25519_kernel
+
+            def run(rows, idx, h_le, s_le, ry, rs):
+                return inner(
+                    jnp.take(rows, idx, axis=0),
+                    ed25519_kernel.expand_digits(h_le),
+                    ed25519_kernel.expand_digits(s_le),
+                    ry,
+                    rs,
+                )
+
+            donate = () if jax.default_backend() == "cpu" else (1, 2, 3, 4, 5)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(mesh, P())
+                data = NamedSharding(mesh, P(batch_axis))
+                fn = jax.jit(
+                    run,
+                    in_shardings=(repl, data, data, data, data, data),
+                    out_shardings=data,
+                    donate_argnums=donate,
+                )
+            else:
+                fn = jax.jit(run, donate_argnums=donate)
             _shared_jit[key] = fn
     return fn
 
@@ -294,9 +394,23 @@ class BatchVerifier:
         min_device_batch: int = 1,
         metrics: Optional[VerifyMetrics] = None,
         recorder=None,
+        chunk_size: int = 0,
+        chunk_depth: int = 2,
     ):
         self.mesh = mesh
         self.batch_axis = batch_axis
+        # How many devices the batch axis is partitioned over (1 = no mesh).
+        # Stamped on every verify.* recorder event so bench/telescope/trace
+        # output can attribute which mesh produced a number.
+        self.shards = (
+            1 if mesh is None else int(np.prod(list(mesh.shape.values())))
+        )
+        # Double-buffered single-shot knobs ([tpu] chunk_size / chunk_depth):
+        # chunk_size 0 = module default _CHUNK; chunk_depth bounds in-flight
+        # donated chunks (host memory stays O(depth·chunk), and the host
+        # can never race more than `depth` dispatches ahead of the device).
+        self.chunk_size = chunk_size
+        self.chunk_depth = chunk_depth
         # observability: nop by default; the node passes its provider's
         # VerifyMetrics and its FlightRecorder.  PubkeyTable / TableCache /
         # AsyncBatchVerifier all report through their verifier's pair, so
@@ -364,7 +478,7 @@ class BatchVerifier:
         t0 = _time.perf_counter()
         _scalar_rows(items)
         prep_per_sig_ms = (_time.perf_counter() - t0) * 1000 / probe_n
-        prep_ms_per_chunk = prep_per_sig_ms * _CHUNK
+        prep_ms_per_chunk = prep_per_sig_ms * self.effective_chunk()
         self.rtt_probe = {
             "dispatch_rtt_ms": rtt_ms,
             "prep_ms_per_chunk": prep_ms_per_chunk,
@@ -375,8 +489,19 @@ class BatchVerifier:
             selected=bool(rtt_ms < prep_ms_per_chunk),
             rtt_ms=round(rtt_ms, 4),
             prep_ms=round(prep_ms_per_chunk, 4),
+            shards=self.shards,
         )
         return self.rtt_probe
+
+    def effective_chunk(self) -> int:
+        """Chunk size for the double-buffered path: the configured size (or
+        the module default), rounded up so each chunk shards evenly over
+        the mesh."""
+        cs = self.chunk_size or _CHUNK
+        m = self._pad_multiple()
+        if cs % m:
+            cs = ((cs + m - 1) // m) * m
+        return cs
 
     def chunked_auto(self) -> bool:
         """True when the RTT probe says chunked single-shot overlap pays."""
@@ -429,6 +554,7 @@ class BatchVerifier:
                 bucket=b,
                 ms=round((_time.perf_counter() - t0) * 1000, 3),
                 ok=ok,
+                shards=self.shards,
             )
 
         # non-daemon: a daemon thread killed mid-XLA-compile at interpreter
@@ -493,7 +619,17 @@ class BatchVerifier:
             if n <= 2048:
                 return _bucket_size(n)
             return ((n + 1023) // 1024) * 1024
-        return _bucket_size(n, self._pad_multiple())
+        m = self._pad_multiple()
+        if n <= 2048:
+            return _bucket_size(n, m)
+        # Same padding-waste bound for the XLA path: pure powers of two pad
+        # a 10k commit to 16384 (+60% device time and transfer); multiples
+        # of lcm(1024, mesh) pad it to 10240 while keeping the shape count
+        # compile-cache friendly and every shard evenly loaded.
+        import math as _math
+
+        step = 1024 * m // _math.gcd(1024, m)
+        return ((n + step - 1) // step) * step
 
     def verify(
         self, pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
@@ -511,12 +647,14 @@ class BatchVerifier:
                 "verify.dispatch", n=n, bucket=0, path="host",
                 host_prep_ms=0.0,
                 device_ms=round((_time.perf_counter() - t0) * 1000, 3),
+                shards=self.shards,
             )
             return out
         b = self._bucket(n)
         if not self._bucket_ready(b):
             self.recorder.record("verify.dispatch", n=n, bucket=b, path="host-cold",
-                                 host_prep_ms=0.0, device_ms=0.0)
+                                 host_prep_ms=0.0, device_ms=0.0,
+                                 shards=self.shards)
             return batch_hook.host_batch_verify(pubkeys, msgs, sigs)
         t0 = _time.perf_counter()
         neg_a, h_digits, s_digits, r_y, r_sign, valid = prepare_batch(pubkeys, msgs, sigs)
@@ -535,6 +673,7 @@ class BatchVerifier:
             "verify.dispatch", n=n, bucket=b, path="device",
             host_prep_ms=round(prep_s * 1000, 3),
             device_ms=round(dev_s * 1000, 3),
+            shards=self.shards,
         )
         return list(np.logical_and(ok, valid))
 
@@ -560,12 +699,16 @@ class PubkeyTable:
     commit verification needs ZERO point doublings — 128 gathered adds per
     signature instead of the 384-op Straus ladder.
 
-    MEASURED AND KEPT OPT-IN: on v5e the gather is the bottleneck, not the
-    VPU — 128 random 160 B table rows per signature (≈2 GB effective HBM
-    traffic per 10k batch after layout) make the tabulated path 85 ms
-    steady-state vs 31 ms for the VMEM-resident ladder (BENCH r5).  The
-    zero-doubling math only pays off if the gather can be made sequential;
-    until then the ladder remains the default device path."""
+    MEASURED: on v5e the gather is the bottleneck, not the VPU — 128
+    random 160 B table rows per signature (≈2 GB effective HBM traffic per
+    10k batch after layout) make the tabulated path 85 ms steady-state vs
+    31 ms for the VMEM-resident ladder (BENCH r5).  The zero-doubling math
+    only pays off if the gather can be made sequential.  `tabulated=None`
+    (the default) is therefore AUTO: a one-time per-process break-even
+    profile (_auto_tabulated) times both kernels at the live bucket shape
+    and engages the tables only where they actually win — on v5e the
+    verdict stays off; a future chip with a faster gather engages with no
+    config change."""
 
     TABULATED_MAX_VALIDATORS = 16384  # ~2.6 GB of HBM tables
 
@@ -589,12 +732,28 @@ class PubkeyTable:
             if limbs is not None:
                 rows[i] = limbs
                 self.row_valid[i] = True
-        self.neg_a_rows = jnp.asarray(rows)  # device-resident
+        if self.verifier.mesh is not None:
+            # HBM-resident and REPLICATED: every chip holds the full table,
+            # so the fused gather stays shard-local (no collectives) and the
+            # sharded jit's replicated in_sharding is already satisfied —
+            # zero per-dispatch table movement.
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.neg_a_rows = jax.device_put(
+                jnp.asarray(rows), NamedSharding(self.verifier.mesh, P())
+            )
+        else:
+            self.neg_a_rows = jnp.asarray(rows)  # device-resident
         self._fused_fn = None
-        if tabulated is None:
-            tabulated = False  # ladder wins on v5e; see class docstring
-        if tabulated and n > self.TABULATED_MAX_VALIDATORS:
+        self._chunk_fn_cached = None
+        self._chunk_sharding = None
+        if n > self.TABULATED_MAX_VALIDATORS:
             tabulated = False
+        # None = auto: resolved at the first real dispatch by a one-time
+        # per-process break-even profile (_auto_tabulated) — engages the
+        # zero-doubling tabulated kernel only where it measures faster than
+        # the ladder.  True/False still force it either way.
         self.tabulated = tabulated
         # Double-buffered chunking overlaps host prep with device compute —
         # a win on locally-attached devices (saves ~prep time), but each
@@ -617,26 +776,137 @@ class PubkeyTable:
             self._window_tables.block_until_ready()
         return self._window_tables
 
+    def _tabulated_active(self, n: int) -> bool:
+        """Resolve the tabulated knob for a real dispatch of n signatures.
+        Explicit True/False pass through; None (auto) profiles once per
+        process and engages only when the break-even holds."""
+        if self.tabulated is None:
+            self.tabulated = self._auto_tabulated(n)
+        return self.tabulated
+
+    def _auto_tabulated(self, n: int) -> bool:
+        """Auto-engage rule: only where the Pallas tabulated kernel can run
+        at all (TPU backend, single device — under a mesh the sharded
+        ladder owns the path), and only when a one-shot timed comparison at
+        this commit's bucket shape says the zero-doubling gather beats the
+        VMEM-resident ladder.  The table build is amortized against the
+        warm validator set; the verdict against the whole process (cached
+        per backend — it is a property of the chip, not the table)."""
+        if not self.verifier._use_pallas():
+            return False
+        import jax
+
+        backend = jax.default_backend()
+        with _tabulated_lock:
+            if backend in _tabulated_verdict:
+                return _tabulated_verdict[backend]
+        verdict = self._profile_tabulated(n)
+        with _tabulated_lock:
+            _tabulated_verdict.setdefault(backend, verdict)
+            return _tabulated_verdict[backend]
+
+    def _profile_tabulated(self, n: int) -> bool:
+        """Time one tabulated dispatch vs one ladder dispatch at this
+        batch's bucket shapes (zero-filled inputs — the kernels are data-
+        oblivious).  Compiles are excluded; min-of-3 each.  Any failure
+        (missing kernel, OOM building tables) keeps the safe ladder."""
+        import time as _time
+
+        try:
+            from ..ops import ed25519_table
+
+            tile = min(_PALLAS_TILE, 256)
+            b = max(((n + tile - 1) // tile) * tile, tile)
+            pk_count = max(len(self.pubkeys), 1)
+            idx = np.zeros(b, dtype=np.int32)
+            h = np.zeros((b, 64), dtype=np.uint8)
+            s = np.zeros((b, 64), dtype=np.uint8)
+            ry = np.zeros((b, _N_LIMBS), dtype=np.int16)
+            rs = np.zeros(b, dtype=np.uint8)
+            t0 = _time.perf_counter()
+            tables = self.build_tables()
+            build_ms = (_time.perf_counter() - t0) * 1000
+
+            def run_tab():
+                np.asarray(
+                    ed25519_table.verify_tabulated(
+                        tables, idx, h, s, ry, rs,
+                        tile=tile, interpret=self._interpret,
+                    )
+                )
+
+            bb = self.verifier._bucket(b)
+            hb, sb, ryb, rsb = _pad_scalar_rows(bb, h, s, ry, rs)
+            hp, sp = _pack_digits(hb), _pack_digits(sb)
+            idx_b = np.zeros(bb, dtype=np.int32)
+            fn = self._fused()
+
+            def run_ladder():
+                np.asarray(fn(self.neg_a_rows, idx_b, hp, sp, ryb, rsb))
+
+            run_tab()
+            run_ladder()  # compiles land outside the timed runs
+            tab_ms = min(_timed(run_tab) for _ in range(3))
+            ladder_ms = min(_timed(run_ladder) for _ in range(3))
+            win = tab_ms < ladder_ms
+            self.verifier.recorder.record(
+                "verify.tabulated_profile",
+                engaged=win,
+                tab_ms=round(tab_ms, 3),
+                ladder_ms=round(ladder_ms, 3),
+                table_build_ms=round(build_ms, 3),
+                bucket=b,
+                validators=pk_count,
+            )
+            return win
+        except Exception:
+            return False
+
     def __len__(self) -> int:
         return len(self.pubkeys)
 
     def _fused(self):
         """One jitted dispatch: on-device gather of the pubkey rows fused
         with the verify kernel — a second dispatch would pay the host↔device
-        round-trip latency twice (it is large on remote-attached TPUs)."""
+        round-trip latency twice (it is large on remote-attached TPUs).
+        Takes PACKED h/s (32 B/scalar, _pack_digits); expansion happens
+        in-kernel.  With a mesh this is the sharded jit (rows replicated,
+        per-signature arrays partitioned over the batch axis)."""
         if self._fused_fn is None:
-            import jax.numpy as jnp
-
-            inner = self.verifier._jitted()
-            if self.verifier.mesh is None:
-                self._fused_fn = _shared_fused_jit(inner)
-            else:
-
-                def run(rows, idx, h, s, ry, rs):
-                    return inner(jnp.take(rows, idx, axis=0), h, s, ry, rs)
-
-                self._fused_fn = run
+            self._fused_fn = _shared_fused_jit(
+                self.verifier._jitted(),
+                self.verifier.mesh,
+                self.verifier.batch_axis,
+            )
         return self._fused_fn
+
+    def _chunked(self):
+        """Per-chunk donated-buffer variant of _fused (see _shared_chunked_jit)."""
+        if self._chunk_fn_cached is None:
+            self._chunk_fn_cached = _shared_chunked_jit(
+                self.verifier._jitted(),
+                self.verifier.mesh,
+                self.verifier.batch_axis,
+            )
+        return self._chunk_fn_cached
+
+    def _put_chunk(self, *arrays):
+        """Async device_put of one chunk's per-signature arrays, pre-
+        partitioned over the mesh when present (SNIPPETS pjit guidance:
+        correctly pre-partitioned inputs skip the resharding step).  The
+        transfer of chunk k+1 overlaps device verify of chunk k, and the
+        resulting jax Arrays are what the donated chunk jit consumes."""
+        import jax
+
+        if self.verifier.mesh is None:
+            return [jax.device_put(a) for a in arrays]
+        if self._chunk_sharding is None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._chunk_sharding = NamedSharding(
+                self.verifier.mesh, P(self.verifier.batch_axis)
+            )
+        return [jax.device_put(a, self._chunk_sharding) for a in arrays]
 
     def verify_indexed(
         self, idxs: Sequence[int], msgs: Sequence[bytes], sigs: Sequence[bytes]
@@ -667,37 +937,60 @@ class PubkeyTable:
             if 0 <= idx < pk_count and self.row_valid[idx]:
                 items[i] = (self.pubkeys[idx], msg, sig)
 
+        tab = self._tabulated_active(n)
+
+        cs = self.verifier.effective_chunk()
         use_chunked = self.chunked_single_shot
-        if use_chunked is None and not self.tabulated and n >= 2 * _CHUNK:
+        chunk_eligible = not tab and n >= 2 * cs
+        if use_chunked is None and chunk_eligible:
             use_chunked = self.verifier.chunked_auto()
-        if use_chunked and not self.tabulated and n >= 2 * _CHUNK:
-            # Double-buffered single-shot: device dispatch is async, so
-            # prepping chunk k+1 on the host while the device runs chunk k
-            # hides most of the host prep inside device time — single-shot
-            # latency ≈ prep(chunk 1) + device(total) instead of
-            # prep(total) + device(total).
-            fn = self._fused()
+        if use_chunked and chunk_eligible:
+            # Double-buffered single-shot: device dispatch (and the
+            # pre-partitioned device_put) is async, so prepping chunk k+1
+            # on the host while the device runs chunk k hides most of the
+            # host prep inside device time — single-shot latency ≈
+            # prep(chunk 1) + device(total) instead of prep(total) +
+            # device(total).
+            fn = self._chunked()
+            depth = max(1, self.verifier.chunk_depth)
             t0 = _time.perf_counter()
-            pending = []
-            for start in range(0, n, _CHUNK):
-                end = min(start + _CHUNK, n)
+            pending: "_collections.deque" = _collections.deque()
+            out: List[bool] = []
+
+            def _collect():
+                dev_ok, valid_c, cnt = pending.popleft()
+                out.extend(
+                    np.logical_and(np.asarray(dev_ok)[:cnt], valid_c).tolist()
+                )
+
+            for start in range(0, n, cs):
+                end = min(start + cs, n)
                 h, s, ry, rs, valid_c = _scalar_rows(items[start:end])
                 cnt = end - start
-                h, s, ry, rs = _pad_scalar_rows(_CHUNK, h, s, ry, rs)
+                h, s, ry, rs = _pad_scalar_rows(cs, h, s, ry, rs)
                 idx_c = idx_arr[start:end]
-                if cnt < _CHUNK:
-                    idx_c = np.concatenate([idx_c, np.zeros(_CHUNK - cnt, np.int32)])
+                if cnt < cs:
+                    idx_c = np.concatenate([idx_c, np.zeros(cs - cnt, np.int32)])
                 idx_c = np.clip(idx_c, 0, pk_count - 1)
-                pending.append((fn(self.neg_a_rows, idx_c, h, s, ry, rs), valid_c, cnt))
-            out: List[bool] = []
-            for dev_ok, valid_c, cnt in pending:
-                out.extend(np.logical_and(np.asarray(dev_ok)[:cnt], valid_c).tolist())
+                # Bound in-flight chunks: fetching the oldest result here
+                # blocks until the device drains it, so donated buffers in
+                # flight stay at O(depth·chunk) and the host never races
+                # more than chunk_depth dispatches ahead of the device.
+                while len(pending) >= depth:
+                    _collect()
+                dev = self._put_chunk(
+                    idx_c, _pack_digits(h), _pack_digits(s), ry, rs
+                )
+                pending.append((fn(self.neg_a_rows, *dev), valid_c, cnt))
+            while pending:
+                _collect()
             # prep and device time interleave by design here; report the
             # overlapped wall time as device_ms and mark the path
             self.verifier.recorder.record(
-                "verify.dispatch", n=n, bucket=_CHUNK, path="chunked",
+                "verify.dispatch", n=n, bucket=cs, path="chunked",
                 host_prep_ms=0.0,
                 device_ms=round((_time.perf_counter() - t0) * 1000, 3),
+                shards=self.verifier.shards,
             )
             return out
 
@@ -708,7 +1001,7 @@ class PubkeyTable:
         if not valid.any():
             return [False] * n
 
-        if self.tabulated:
+        if tab:
             from ..ops import ed25519_table
 
             tile = min(_PALLAS_TILE, 256)
@@ -738,6 +1031,7 @@ class PubkeyTable:
                 "verify.dispatch", n=n, bucket=b, path="tabulated",
                 host_prep_ms=round(prep_s * 1000, 3),
                 device_ms=round(dev_s * 1000, 3),
+                shards=self.verifier.shards,
             )
             return list(np.logical_and(ok, valid))
 
@@ -748,7 +1042,10 @@ class PubkeyTable:
         idx_arr = np.clip(idx_arr, 0, pk_count - 1)
         t1 = _time.perf_counter()
         ok = np.asarray(
-            self._fused()(self.neg_a_rows, idx_arr, h_digits, s_digits, r_y, r_sign)
+            self._fused()(
+                self.neg_a_rows, idx_arr,
+                _pack_digits(h_digits), _pack_digits(s_digits), r_y, r_sign,
+            )
         )[:n]
         dev_s = _time.perf_counter() - t1
         self.verifier.metrics.device_seconds.observe(dev_s)
@@ -756,6 +1053,7 @@ class PubkeyTable:
             "verify.dispatch", n=n, bucket=b, path="indexed",
             host_prep_ms=round(prep_s * 1000, 3),
             device_ms=round(dev_s * 1000, 3),
+            shards=self.verifier.shards,
         )
         return list(np.logical_and(ok, valid))
 
@@ -1161,6 +1459,7 @@ class AsyncBatchVerifier(Service):
                 batch=len(batch),
                 wait_ms=round(wait_s * 1000, 3),
                 quantum_ms=round(quantum_s * 1000, 3),
+                shards=self.verifier.shards,
             )
             pubkeys = [b[0] for b in batch]
             msgs = [b[1] for b in batch]

@@ -36,7 +36,7 @@ _R2 = (_R * _R) % P
 _N0INV = (-pow(P, -1, 1 << RADIX)) & MASK  # -P⁻¹ mod 2⁸
 
 _jax = None
-_fns = {}  # bucket size -> (jitted g1 agg, jitted g2 agg)
+_fns = {}  # (bucket, mesh, axis) -> (jitted g1 agg, jitted g2 agg)
 
 
 def available() -> bool:
@@ -63,7 +63,7 @@ def _limbs_to_int(a) -> int:
     return int.from_bytes(bytes(np.asarray(a, dtype=np.int32).astype(np.uint8)), "little")
 
 
-def _build(bucket: int):
+def _build(bucket: int, mesh=None, batch_axis: str = "batch"):
     """Construct the jitted [bucket]-point G1 and G2 aggregators."""
     import jax
     import jax.numpy as jnp
@@ -237,15 +237,31 @@ def _build(bucket: int):
         pts = lax.fori_loop(0, steps, level, pts)
         return pts[0]
 
-    g1 = jax.jit(lambda pts: _tree(pts, g1_padd))
-    g2 = jax.jit(lambda pts: _tree(pts, g2_padd))
+    if mesh is not None:
+        # Sharded fold: points partitioned over the batch axis, output (the
+        # tree root) replicated.  The roll-based tree reduction stays a
+        # single jit — GSPMD lowers each level's roll to a collective
+        # permute of boundary lanes, while the dominant cost (the vmapped
+        # CIOS point-adds over all bucket lanes) splits across shards.
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        data = NamedSharding(mesh, PS(batch_axis))
+        repl = NamedSharding(mesh, PS())
+        g1 = jax.jit(lambda pts: _tree(pts, g1_padd),
+                     in_shardings=(data,), out_shardings=repl)
+        g2 = jax.jit(lambda pts: _tree(pts, g2_padd),
+                     in_shardings=(data,), out_shardings=repl)
+    else:
+        g1 = jax.jit(lambda pts: _tree(pts, g1_padd))
+        g2 = jax.jit(lambda pts: _tree(pts, g2_padd))
     return g1, g2
 
 
-def _get_fns(bucket: int):
-    if bucket not in _fns:
-        _fns[bucket] = _build(bucket)
-    return _fns[bucket]
+def _get_fns(bucket: int, mesh=None, batch_axis: str = "batch"):
+    key = (bucket, mesh, batch_axis)
+    if key not in _fns:
+        _fns[key] = _build(bucket, mesh, batch_axis)
+    return _fns[key]
 
 
 def _to_mont(x: int) -> int:
@@ -263,7 +279,27 @@ def _bucket(n: int) -> int:
     return b
 
 
-def aggregate_g1(pts: Sequence[Tuple[int, int, int]]) -> Optional[Tuple[int, int, int]]:
+def _mesh_bucket(n: int, mesh):
+    """Bucket + effective mesh for a fold of n points.  The masked tree
+    needs power-of-two buckets, and a sharded batch axis must divide
+    evenly — so the bucket grows to the mesh size for tiny folds, and a
+    non-power-of-two mesh degrades to the single-device fold."""
+    b = max(2, _bucket(n))
+    if mesh is None:
+        return b, None
+    import numpy as np
+
+    m = int(np.prod(list(mesh.shape.values())))
+    if m < 2 or m & (m - 1):
+        return b, None
+    while b % m:
+        b *= 2
+    return b, mesh
+
+
+def aggregate_g1(
+    pts: Sequence[Tuple[int, int, int]], mesh=None
+) -> Optional[Tuple[int, int, int]]:
     """Σ of Jacobian G1 points via the batched device tree; None on any
     failure (caller falls back to the pure fold)."""
     try:
@@ -271,13 +307,13 @@ def aggregate_g1(pts: Sequence[Tuple[int, int, int]]) -> Optional[Tuple[int, int
 
         if not available() or not pts:
             return None
-        b = max(2, _bucket(len(pts)))
+        b, mesh = _mesh_bucket(len(pts), mesh)
         rows = np.zeros((b, 3, NL), dtype=np.int32)
         for i, (x, y, z) in enumerate(pts):
             rows[i, 0] = _int_to_limbs(_to_mont(x % P))
             rows[i, 1] = _int_to_limbs(_to_mont(y % P))
             rows[i, 2] = _int_to_limbs(_to_mont(z % P))
-        g1_fn, _ = _get_fns(b)
+        g1_fn, _ = _get_fns(b, mesh)
         out = np.asarray(g1_fn(rows))
         return (
             _from_mont(_limbs_to_int(out[0])),
@@ -288,20 +324,20 @@ def aggregate_g1(pts: Sequence[Tuple[int, int, int]]) -> Optional[Tuple[int, int
         return None
 
 
-def aggregate_g2(pts) -> Optional[tuple]:
+def aggregate_g2(pts, mesh=None) -> Optional[tuple]:
     """Σ of Jacobian G2 points (Fp2 coords as int pairs)."""
     try:
         import numpy as np
 
         if not available() or not pts:
             return None
-        b = max(2, _bucket(len(pts)))
+        b, mesh = _mesh_bucket(len(pts), mesh)
         rows = np.zeros((b, 3, 2, NL), dtype=np.int32)
         for i, (x, y, z) in enumerate(pts):
             for ci, coord in enumerate((x, y, z)):
                 rows[i, ci, 0] = _int_to_limbs(_to_mont(coord[0] % P))
                 rows[i, ci, 1] = _int_to_limbs(_to_mont(coord[1] % P))
-        _, g2_fn = _get_fns(b)
+        _, g2_fn = _get_fns(b, mesh)
         out = np.asarray(g2_fn(rows))
         return (
             (_from_mont(_limbs_to_int(out[0, 0])), _from_mont(_limbs_to_int(out[0, 1]))),

@@ -268,7 +268,7 @@ def _sum_g1(pts):
     # ctier.g1_sum/g2_sum directly, never through here)
     jt = _jax_aggregator()
     if jt is not None and len(pts) >= jt.MIN_BATCH:
-        out = jt.aggregate_g1(pts)
+        out = jt.aggregate_g1(pts, mesh=_jax_agg_mesh)
         if out is not None:
             return out
     acc = curve.G1_INF
@@ -280,7 +280,7 @@ def _sum_g1(pts):
 def _sum_g2(pts):
     jt = _jax_aggregator()
     if jt is not None and len(pts) >= jt.MIN_BATCH:
-        out = jt.aggregate_g2(pts)
+        out = jt.aggregate_g2(pts, mesh=_jax_agg_mesh)
         if out is not None:
             return out
     acc = curve.G2_INF
@@ -290,14 +290,18 @@ def _sum_g2(pts):
 
 
 _jax_agg_enabled = False
+_jax_agg_mesh = None
 
 
-def set_jax_aggregation(enabled: bool) -> None:
+def set_jax_aggregation(enabled: bool, mesh=None) -> None:
     """Route multi-point G1/G2 sums through the batched JAX tier (engine
     nodes turn this on at startup; the pure tier stays the default so a
-    JAX-less host never pays an import)."""
-    global _jax_agg_enabled
+    JAX-less host never pays an import).  `mesh` shards the fold's batch
+    axis across the verify engine's device mesh (jax_tier._mesh_bucket
+    degrades it to single-device when the fold can't shard evenly)."""
+    global _jax_agg_enabled, _jax_agg_mesh
     _jax_agg_enabled = bool(enabled)
+    _jax_agg_mesh = mesh if enabled else None
 
 
 def _jax_aggregator():

@@ -330,7 +330,7 @@ class VerifyMetrics:
                 "batch_size", "queue_wait_seconds", "host_prep_seconds",
                 "device_seconds", "flush_quantum_seconds", "bucket_compiles",
                 "table_cache_hits", "table_cache_misses", "backend_tier",
-                "bls_agg_seconds", "bls_agg_checks", "bls_tier",
+                "shards", "bls_agg_seconds", "bls_agg_checks", "bls_tier",
             ):
                 setattr(self, name, _NOP)
             return
@@ -382,6 +382,10 @@ class VerifyMetrics:
         self.backend_tier = g(
             "backend_tier",
             "Active host crypto backend: 1=cryptography, 2=C extension, 3=pure python.",
+        )
+        self.shards = g(
+            "shards",
+            "Devices the verify batch axis is sharded over (1 = single device).",
         )
         self.bls_agg_seconds = h(
             "bls_agg_seconds",

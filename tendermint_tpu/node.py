@@ -280,22 +280,36 @@ class Node(Service):
         if cfg.tpu.enabled:
             from .crypto.batch_verifier import AsyncBatchVerifier, BatchVerifier, TableCache
 
-            mesh = None
-            if cfg.tpu.mesh_devices > 1:
-                import jax
-                from jax.sharding import Mesh
-
-                devs = jax.devices()[: cfg.tpu.mesh_devices]
-                mesh = Mesh(devs, ("batch",))
+            # Mesh probe ([tpu] mesh = auto|on|off, mesh_devices caps the
+            # shard count): sharding degrades to single-device — never a
+            # startup failure — and the decision is attributed right next
+            # to the host-crypto tier so an operator can read one log line
+            # and know which engine this node actually runs.
+            mesh, shards, mesh_reason = _crypto_backend.resolve_mesh(
+                cfg.tpu.mesh, cfg.tpu.mesh_devices
+            )
+            self.metrics_provider.verify.shards.set(shards)
+            self.log.info(
+                "verify engine",
+                shards=shards,
+                mesh=mesh_reason,
+                host_tier=_crypto_backend.active_tier(),
+            )
             self.batch_verifier = BatchVerifier(
                 mesh=mesh,
                 min_device_batch=cfg.tpu.min_device_batch,
                 metrics=self.metrics_provider.verify,
                 recorder=self.flight_recorder,
+                chunk_size=cfg.tpu.chunk_size,
+                chunk_depth=cfg.tpu.chunk_depth,
             ).install()
-            # steady-state commit path: per-valset device tables (HBM rows;
-            # tabulated zero-doubling windows on a TPU backend)
-            self.table_cache = TableCache(self.batch_verifier).install()
+            # steady-state commit path: per-valset device tables (HBM rows,
+            # replicated across the mesh; tabulated zero-doubling windows
+            # auto-profiled on a TPU backend)
+            self.table_cache = TableCache(
+                self.batch_verifier,
+                tabulated={"auto": None, "on": True, "off": False}[cfg.tpu.tabulated],
+            ).install()
             self.async_verifier = AsyncBatchVerifier(
                 self.batch_verifier,
                 max_batch=cfg.tpu.max_batch,
@@ -307,7 +321,7 @@ class Node(Service):
             if cfg.tpu.bls_jax_aggregation:
                 from .crypto.bls import scheme as _bls_scheme
 
-                _bls_scheme.set_jax_aggregation(True)
+                _bls_scheme.set_jax_aggregation(True, mesh=mesh)
         # remote signer: wait for the external signer to dial in BEFORE
         # consensus needs a pubkey (node/node.go:612-618)
         if isinstance(self.priv_validator, Service) and not self.priv_validator.is_running:

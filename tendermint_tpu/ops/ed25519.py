@@ -107,4 +107,34 @@ def verify_prepared(
     return ok_y & ok_sign
 
 
+def expand_digits(packed_le: jnp.ndarray) -> jnp.ndarray:
+    """[B, 32] little-endian scalar bytes -> [B, 64] 4-bit window digits,
+    MSB first — the device-side twin of batch_verifier._msb_digits.
+
+    Kept as a kernel-level op so dispatch paths can ship 32 packed bytes
+    per scalar instead of 64 digit bytes: on remote-attached devices the
+    single-shot latency is transfer-bound, and halving the h/s payload is
+    free VPU work (two shifts and an interleave, fused into the verify
+    kernel's prologue by XLA)."""
+    lo = packed_le & 15
+    hi = packed_le >> 4
+    dig = jnp.stack([lo, hi], axis=-1).reshape(packed_le.shape[0], 64)
+    return dig[:, ::-1]
+
+
+def verify_prepared_packed(
+    neg_a: jnp.ndarray,  # [B, 4, 20] int extended coords of -A
+    h_le: jnp.ndarray,  # [B, 32] little-endian bytes of h (mod L)
+    s_le: jnp.ndarray,  # [B, 32] little-endian bytes of s
+    r_y_raw: jnp.ndarray,  # [B, 20] raw (unreduced) y limbs from sig R bytes
+    r_sign: jnp.ndarray,  # [B] x-parity bit from sig R bytes
+) -> jnp.ndarray:
+    """verify_prepared with in-kernel digit expansion (32 B/scalar wire
+    format).  Bit-identical to expanding on the host: digits are 4-bit, so
+    pack→expand round-trips exactly."""
+    return verify_prepared(
+        neg_a, expand_digits(h_le), expand_digits(s_le), r_y_raw, r_sign
+    )
+
+
 verify_prepared_jit = jax.jit(verify_prepared)

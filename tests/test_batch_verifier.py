@@ -429,19 +429,200 @@ class TestWarmup:
             await svc.stop()
 
 
+def _mesh8():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual CPU devices (conftest XLA_FLAGS)")
+    return Mesh(np.array(devs[:8]), ("batch",))
+
+
 class TestSharded:
     def test_mesh_sharded_verify(self):
-        import jax
-        from jax.sharding import Mesh
-
-        devs = np.array(jax.devices("cpu")[:8])
-        mesh = Mesh(devs, ("batch",))
-        v = BatchVerifier(mesh=mesh)
+        v = BatchVerifier(mesh=_mesh8())
         pubkeys, msgs, sigs = make_sigs(10)
         sigs[7] = bytes(64)
         want = [True] * 10
         want[7] = False
         assert v.verify(pubkeys, msgs, sigs) == want
+
+    def test_sharded_indexed_differential_vs_single_device(self):
+        """The sharded fused dispatch must be BIT-IDENTICAL to the
+        single-device engine on a mixed valid/invalid indexed batch."""
+        pubkeys, msgs, sigs = make_sigs(16)
+        n = 96
+        idxs = [i % 16 for i in range(n)]
+        ms = [msgs[i] for i in idxs]
+        ss = [sigs[i] for i in idxs]
+        ss[5] = bytes(64)  # garbage
+        ss[33] = ss[33][:10] + bytes([ss[33][10] ^ 0x40]) + ss[33][11:]
+        ms[70] = b"forged"  # wrong message
+        idxs[90] = 999  # out-of-range validator row
+
+        mesh_tab = PubkeyTable(pubkeys, BatchVerifier(mesh=_mesh8()))
+        solo_tab = PubkeyTable(pubkeys, BatchVerifier())
+        got_mesh = mesh_tab.verify_indexed(idxs, ms, ss)
+        got_solo = solo_tab.verify_indexed(idxs, ms, ss)
+        assert got_mesh == got_solo
+        expect = [True] * n
+        for j in (5, 33, 70, 90):
+            expect[j] = False
+        assert got_mesh == expect
+
+    def test_liar_attribution_on_every_shard(self):
+        """One invalid signature placed at each shard's slice of the batch:
+        the verdict vector must point at exactly those rows — a liar on
+        shard k must never be blamed on a row owned by shard j."""
+        pubkeys, msgs, sigs = make_sigs(16)
+        n = 64  # 8 rows per shard on the 8-device mesh
+        idxs = [i % 16 for i in range(n)]
+        ms = [msgs[i] for i in idxs]
+        ss = [sigs[i] for i in idxs]
+        liars = [shard * 8 + 3 for shard in range(8)]  # one per shard
+        for j in liars:
+            ss[j] = bytes(64)
+        expect = [i not in liars for i in range(n)]
+        tab = PubkeyTable(pubkeys, BatchVerifier(mesh=_mesh8()))
+        assert tab.verify_indexed(idxs, ms, ss) == expect
+
+    def test_ragged_batches_no_verdict_leakage(self):
+        """Sizes not divisible by the shard count pad up to the bucket;
+        padding rows must never leak into (or flip) real verdicts."""
+        pubkeys, msgs, sigs = make_sigs(16)
+        tab = PubkeyTable(pubkeys, BatchVerifier(mesh=_mesh8()))
+        for n in (13, 27, 67):
+            idxs = [i % 16 for i in range(n)]
+            ms = [msgs[i] for i in idxs]
+            ss = [sigs[i] for i in idxs]
+            expect = [True] * n
+            ss[n - 1] = bytes(64)
+            expect[n - 1] = False
+            assert tab.verify_indexed(idxs, ms, ss) == expect, n
+
+    def test_sharded_chunked_matches(self, monkeypatch):
+        from tendermint_tpu.crypto import batch_verifier as bv_mod
+
+        monkeypatch.setattr(bv_mod, "_CHUNK", 16)
+        pubkeys, msgs, sigs = make_sigs(16)
+        tab = PubkeyTable(pubkeys, BatchVerifier(mesh=_mesh8()))
+        tab.chunked_single_shot = True
+        n = 48
+        idxs = [i % 16 for i in range(n)]
+        ms = [msgs[i] for i in idxs]
+        ss = [sigs[i] for i in idxs]
+        ss[20] = bytes(64)
+        expect = [True] * n
+        expect[20] = False
+        assert tab.verify_indexed(idxs, ms, ss) == expect
+
+    def test_pack_expand_round_trip(self):
+        """Host-side packed 32-byte scalars must expand on-device to the
+        exact window digits the unpacked wire format would have carried."""
+        import jax.numpy as jnp
+
+        from tendermint_tpu.crypto.batch_verifier import _pack_digits, _scalar_rows
+        from tendermint_tpu.ops import ed25519_kernel
+
+        pubkeys, msgs, sigs = make_sigs(5)
+        items = list(zip(pubkeys, msgs, sigs))
+        h_digits, s_digits, _, _, _ = _scalar_rows(items)
+        for digits in (h_digits, s_digits):
+            packed = _pack_digits(digits)
+            assert packed.shape == (len(items), 32)
+            expanded = np.asarray(ed25519_kernel.expand_digits(jnp.asarray(packed)))
+            np.testing.assert_array_equal(expanded, digits)
+
+
+class TestResolveMesh:
+    def test_off_never_shards(self):
+        from tendermint_tpu.crypto.backend import resolve_mesh
+
+        mesh, shards, reason = resolve_mesh("off", 8)
+        assert mesh is None and shards == 1 and "off" in reason
+
+    def test_auto_ignores_virtual_cpu_devices(self):
+        from tendermint_tpu.crypto.backend import resolve_mesh
+
+        mesh, shards, reason = resolve_mesh("auto", 0)
+        assert mesh is None and shards == 1
+        assert "virtual cpu" in reason
+
+    def test_auto_with_explicit_device_cap_opts_in(self):
+        from tendermint_tpu.crypto.backend import resolve_mesh
+
+        mesh, shards, reason = resolve_mesh("auto", 4)
+        assert mesh is not None and shards == 4
+
+    def test_on_shards_any_platform(self):
+        from tendermint_tpu.crypto.backend import resolve_mesh
+
+        mesh, shards, reason = resolve_mesh("on", 8)
+        assert mesh is not None and shards == 8
+        assert "sharded over 8" in reason
+
+    def test_probe_failure_degrades_to_single_device(self, monkeypatch):
+        import jax
+
+        from tendermint_tpu.crypto.backend import resolve_mesh
+
+        def boom(*a, **k):
+            raise RuntimeError("device plane down")
+
+        monkeypatch.setattr(jax, "devices", boom)
+        mesh, shards, reason = resolve_mesh("on", 8)
+        assert mesh is None and shards == 1
+        assert "mesh probe failed" in reason
+
+
+class TestShardedWarmup:
+    def test_no_compile_after_warmup_on_mesh(self):
+        """start_warmup on a mesh engine must compile the SHARDED bucket
+        executable — the first live dispatch after warmup lands must not
+        trigger any new XLA compilation."""
+        import time
+
+        v = BatchVerifier(mesh=_mesh8())
+        v.start_warmup()
+        b = v._bucket(max(1, v.min_device_batch))
+        deadline = time.time() + 120
+        while time.time() < deadline and b not in v._ready_buckets:
+            time.sleep(0.05)
+        assert b in v._ready_buckets, "warmup compile never landed"
+        fn = v._jitted()
+        compiled = fn._cache_size()
+        assert compiled >= 1
+        pubkeys, msgs, sigs = make_sigs(3)
+        assert v.verify(pubkeys, msgs, sigs) == [True, True, True]
+        assert fn._cache_size() == compiled, "post-warmup dispatch recompiled"
+
+
+class TestMeshConfigKnobs:
+    def _cfg(self):
+        from tendermint_tpu.config import Config
+
+        return Config(home="/tmp/x")
+
+    @pytest.mark.parametrize("field,bad,match", [
+        ("mesh", "sideways", "mesh"),
+        ("mesh_devices", -1, "mesh_devices"),
+        ("chunk_size", -8, "chunk_size"),
+        ("chunk_depth", 0, "chunk_depth"),
+        ("tabulated", "maybe", "tabulated"),
+    ])
+    def test_bad_knob_rejected(self, field, bad, match):
+        cfg = self._cfg()
+        setattr(cfg.tpu, field, bad)
+        with pytest.raises(ValueError, match=match):
+            cfg.validate_basic()
+
+    def test_defaults_validate(self):
+        cfg = self._cfg()
+        cfg.validate_basic()
+        assert cfg.tpu.mesh == "auto"
+        assert cfg.tpu.chunk_depth == 2
+        assert cfg.tpu.tabulated == "auto"
 
 
 # ---------------------------------------------------------------------------
