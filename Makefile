@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench bench-smoke mesh-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke disk-smoke scale-smoke bls-smoke bls-ext load-smoke forensics-smoke finality-smoke localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke mesh-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke disk-smoke scale-smoke bls-smoke bls-ext load-smoke lite-smoke forensics-smoke finality-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -56,6 +56,10 @@ bls-ext:         ## prebuild the BLS12-381 C pairing tier (.so) so suite/node ru
 load-smoke:      ## tx-ingress firehose vs a QoS-configured 4-val localnet: explicit overload errors, zero checker violations, commit rate recovers
 	$(PY) networks/local/load_smoke.py --json
 	rm -rf build-load
+
+lite-smoke:      ## multi-tenant light-client gateway vs a live 4-val localnet: 64 bisecting sessions off one shared engine, then an adversarial twin-signing primary gets detected, demoted, and rolled back
+	$(PY) networks/local/lite_smoke.py --json
+	rm -rf build-lite
 
 forensics-smoke: ## watchdog detects an injected partition live; a SIGKILLed node's debug bundle reconstructs its pre-crash span chains from the spool, offline
 	$(PY) networks/local/forensics_smoke.py --json

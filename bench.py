@@ -500,6 +500,35 @@ def bench_load():
         return json.loads(run.stdout.strip().splitlines()[-1])
 
 
+def bench_lite():
+    """Light-client gateway acceptance as numbers: run the liteserve rig
+    (networks/local/lite_smoke.py — 64 concurrent bisecting sessions
+    against a gateway fronting a live 4-val localnet, then an adversarial
+    twin-signing primary) and report `lite_bisections_per_sec` (tenant
+    commits verified per second off the shared engine),
+    `lite_cache_hit_ratio` / `lite_verify_coalesce_ratio` (work avoided by
+    the shared store and single-flight coalescing),
+    `lite_sessions_sustained`, and `lite_diverged_detect_ms` (wall time
+    from the forged header being served to the tenant getting the real
+    one back, primary demoted).  Raises if any invariant failed — a
+    forged header reaching a tenant or the shared store fails the smoke,
+    not just the bench."""
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as tmp:
+        run = subprocess.run(
+            [sys.executable, os.path.join(repo, "networks", "local", "lite_smoke.py"),
+             "--build-dir", os.path.join(tmp, "build"), "--base-port", "33656", "--json"],
+            capture_output=True, text=True, timeout=420, cwd=repo,
+        )
+        if run.returncode != 0:
+            raise RuntimeError(f"lite smoke failed:\n{run.stdout}\n{run.stderr}")
+        return json.loads(run.stdout.strip().splitlines()[-1])
+
+
 def bench_finality():
     """Consensus-pipeline finality as numbers: run the A/B finality rig
     (networks/local/finality_smoke.py — the same 4-val localnet measured
@@ -990,6 +1019,10 @@ def main() -> None:
         finality = bench_finality()
     except Exception as e:
         finality = {"commit_to_commit_p50_ms": -1.0, "error": str(e)[:300]}
+    try:
+        lite = bench_lite()
+    except Exception as e:
+        lite = {"lite_bisections_per_sec": -1.0, "error": str(e)[:300]}
     extras = {
         "commit_verify_100val_ms": bench_100val_commit(),
         "e2e_commits_per_sec_solo": asyncio.run(bench_e2e_commits()),
@@ -1037,6 +1070,11 @@ def main() -> None:
         "load_throttled": load.get("throttled"),
         "load_idle_commits_per_sec": load.get("idle_commits_per_sec"),
         "load_recovery_commits_per_sec": load.get("recovery_commits_per_sec"),
+        "lite_bisections_per_sec": lite.get("lite_bisections_per_sec", -1.0),
+        "lite_cache_hit_ratio": lite.get("lite_cache_hit_ratio", -1.0),
+        "lite_verify_coalesce_ratio": lite.get("lite_verify_coalesce_ratio"),
+        "lite_sessions_sustained": lite.get("lite_sessions_sustained", -1),
+        "lite_diverged_detect_ms": lite.get("lite_diverged_detect_ms", -1.0),
         "commit_to_commit_p50_ms": finality.get("commit_to_commit_p50_ms", -1.0),
         "commit_to_commit_p90_ms": finality.get("commit_to_commit_p90_ms", -1.0),
         "commit_to_commit_p50_ms_serial": finality.get("commit_to_commit_p50_ms_serial"),

@@ -365,6 +365,43 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_liteserve(args) -> int:
+    """Run the standalone multi-tenant light-client verification gateway
+    (liteserve/service.py): lite_* JSON-RPC routes off one shared
+    verification engine with witness rotation and a bounded session table."""
+    from .liteserve.service import run_service
+
+    kwargs = {}
+    if args.metrics_laddr:
+        from .libs.metrics import MetricsProvider
+
+        provider = MetricsProvider(True, args.chain_id)
+        kwargs["metrics"] = provider.liteserve
+        kwargs["metrics_provider"] = provider
+    asyncio.run(
+        run_service(
+            chain_id=args.chain_id,
+            primary_addr=args.primary,
+            witness_addrs=[w for w in (args.witnesses or "").split(",") if w],
+            laddr=args.laddr,
+            trust_height=args.height,
+            trust_hash=bytes.fromhex(args.hash),
+            trusting_period_s=args.trusting_period,
+            cache_capacity=args.cache_capacity,
+            max_sessions=args.max_sessions,
+            session_rate=args.session_rate,
+            session_burst=args.session_burst,
+            create_rate=args.create_rate,
+            create_burst=args.create_burst,
+            witness_quorum=args.witness_quorum,
+            witness_timeout_s=args.witness_timeout,
+            rotation_seed=args.rotation_seed,
+            **kwargs,
+        )
+    )
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Dump a running node's flight recorder (libs/tracing.py) via the
     dump_flight_recorder RPC route.  Default output is a human timeline
@@ -939,6 +976,32 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hash", required=True, help="trusted header hash (hex)")
     sp.add_argument("--trusting-period", type=float, default=168 * 3600)
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser(
+        "liteserve",
+        help="run the multi-tenant light-client verification gateway",
+    )
+    sp.add_argument("--chain-id", required=True)
+    sp.add_argument("--primary", required=True, help="primary node RPC address")
+    sp.add_argument("--witnesses", default="", help="comma-separated witness RPC addresses")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8899")
+    sp.add_argument("--height", type=int, required=True, help="trusted height")
+    sp.add_argument("--hash", required=True, help="trusted header hash (hex)")
+    sp.add_argument("--trusting-period", type=float, default=168 * 3600)
+    sp.add_argument("--cache-capacity", type=int, default=4096)
+    sp.add_argument("--max-sessions", type=int, default=4096)
+    sp.add_argument("--session-rate", type=float, default=0.0,
+                    help="per-session requests/sec (0 = unlimited)")
+    sp.add_argument("--session-burst", type=int, default=50)
+    sp.add_argument("--create-rate", type=float, default=0.0,
+                    help="per-source session creates/sec (0 = unlimited)")
+    sp.add_argument("--create-burst", type=int, default=20)
+    sp.add_argument("--witness-quorum", type=int, default=2)
+    sp.add_argument("--witness-timeout", type=float, default=3.0)
+    sp.add_argument("--rotation-seed", type=int, default=0)
+    sp.add_argument("--metrics-laddr", default="",
+                    help="serve /metrics on the gateway listener (any value enables)")
+    sp.set_defaults(fn=cmd_liteserve)
 
     sp = sub.add_parser(
         "debug", help="capture forensics bundles from a running (or dead) node"

@@ -381,6 +381,41 @@ class TxIndexConfig:
 
 
 @dataclass
+class LiteServeConfig:
+    """Multi-tenant light-client verification gateway (liteserve/).
+
+    With `enable`, the node (or the standalone `liteserve` CLI) serves
+    `lite_*` JSON-RPC routes off one shared verification engine:
+    `primary`/`witnesses` are the provider RPC addresses,
+    `trust_height`/`trust_hash` the gateway's own subjective root (same
+    semantics as [statesync]).  `cache_capacity` bounds the shared
+    commit-verification LRU; `max_sessions` bounds the tenant table, with
+    `session_rate`/`create_rate` token buckets enforcing the PR 11
+    explicit-overload discipline (-32005 + retry_after, never silent
+    queueing).  `witness_quorum` witnesses are rotated in per
+    verification pass from the diversity pool."""
+
+    enable: bool = False
+    laddr: str = "tcp://127.0.0.1:8899"
+    primary: str = ""
+    witnesses: str = ""  # comma-separated RPC addresses
+    trust_height: int = 0
+    trust_hash: str = ""  # hex
+    trust_period: float = 168 * 3600.0  # seconds
+    cache_capacity: int = 4096
+    max_sessions: int = 4096
+    idle_timeout: float = 300.0  # seconds before an idle session is evictable
+    session_rate: float = 0.0  # per-session requests/sec (0 = unlimited)
+    session_burst: int = 50
+    create_rate: float = 0.0  # per-source session creates/sec (0 = unlimited)
+    create_burst: int = 20
+    witness_quorum: int = 2
+    witness_timeout: float = 3.0  # per-witness cross-check timeout (seconds)
+    rotation_seed: int = 0
+    max_body_bytes: int = 1_000_000
+
+
+@dataclass
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
@@ -467,6 +502,7 @@ class Config:
     storage: StorageConfig = field(default_factory=StorageConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+    liteserve: LiteServeConfig = field(default_factory=LiteServeConfig)
 
     # -- paths -------------------------------------------------------------
     def _join(self, p: str) -> str:
@@ -675,6 +711,7 @@ def save_config(cfg: Config, path: str) -> None:
         "storage": cfg.storage,
         "tx_index": cfg.tx_index,
         "instrumentation": cfg.instrumentation,
+        "liteserve": cfg.liteserve,
     }
     for name, section in sections.items():
         if name:
@@ -724,4 +761,5 @@ def load_config(path: str, home: Optional[str] = None) -> Config:
     apply(cfg.storage, data.get("storage", {}))
     apply(cfg.tx_index, data.get("tx_index", {}))
     apply(cfg.instrumentation, data.get("instrumentation", {}))
+    apply(cfg.liteserve, data.get("liteserve", {}))
     return cfg

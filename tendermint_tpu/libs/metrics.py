@@ -699,6 +699,54 @@ class HealthMetrics:
         ).labels(chain_id=chain_id)
 
 
+class LiteServeMetrics:
+    """Multi-tenant light-client gateway (subsystem `liteserve`;
+    liteserve/service.py — no reference counterpart: the reference light
+    client is strictly single-tenant).  `cache_hits` / `cache_misses` /
+    `coalesced_verifies` are the request-level shared-store counters the
+    `lite_cache_hit_ratio` and `lite_verify_coalesce_ratio` bench keys
+    derive from; `bisections_total` counts verification passes that
+    actually walked the chain; `diverged_headers`, `witness_demotions`
+    and `primary_replacements` expose the adversarial-primary recovery
+    path (a nonzero `primary_replacements` in production is an incident,
+    not noise)."""
+
+    def __init__(self, registry=None, chain_id: str = ""):
+        names = (
+            "sessions", "cache_hits", "cache_misses", "coalesced_verifies",
+            "bisections_total", "diverged_headers", "witness_demotions",
+            "primary_replacements",
+        )
+        if registry is None:
+            for n in names:
+                setattr(self, n, _NOP)
+            return
+        from prometheus_client import Gauge
+
+        kw = dict(
+            namespace=NAMESPACE, subsystem="liteserve", registry=registry,
+            labelnames=("chain_id",),
+        )
+        descriptions = {
+            "sessions": "Live tenant sessions in the bounded session table.",
+            "cache_hits": "Tenant lookups served straight from the shared light store.",
+            "cache_misses": "Tenant lookups that required a verification pass.",
+            "coalesced_verifies":
+                "Tenant lookups that joined an in-flight verification "
+                "(single-flight coalescing).",
+            "bisections_total": "Verification passes run by the shared engine.",
+            "diverged_headers": "Conflicting headers detected via witness cross-check.",
+            "witness_demotions": "Witnesses demoted out of the rotation pool.",
+            "primary_replacements":
+                "Primaries demoted and replaced by a promoted witness.",
+        }
+        for n in names:
+            setattr(
+                self, n,
+                Gauge(n, descriptions[n], **kw).labels(chain_id=chain_id),
+            )
+
+
 class MetricsProvider:
     """node/node.go:128 DefaultMetricsProvider — one registry per node."""
 
@@ -722,6 +770,7 @@ class MetricsProvider:
         self.chaos = ChaosMetrics(self.registry, chain_id)
         self.health = HealthMetrics(self.registry, chain_id)
         self.storage = StorageMetrics(self.registry, chain_id)
+        self.liteserve = LiteServeMetrics(self.registry, chain_id)
 
     def exposition(self) -> bytes:
         if self.registry is None:

@@ -13,9 +13,10 @@ types/validator_set.go:641-668 never runs here.
 
 from __future__ import annotations
 
+import asyncio
 import time
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..libs.log import get_logger
 from ..types import SignedHeader
@@ -84,6 +85,9 @@ class Client:
         max_retained_headers: int = 0,
         now_fn=time.time_ns,
         commit_preverify=None,
+        witness_timeout_s: float = 5.0,
+        witness_error_threshold: int = 3,
+        on_witness_demoted=None,
     ):
         """`commit_preverify` is an optional async hook
         `(signed_header, [validator_sets]) -> batch_verify | None` invoked
@@ -106,6 +110,15 @@ class Client:
         self.max_retained_headers = max_retained_headers
         self.now_fn = now_fn
         self.commit_preverify = commit_preverify
+        # -- witness health: a witness that errors repeatedly (hung, dark,
+        # or garbage) is DEMOTED out of the active pool instead of being
+        # silently skipped forever — replace_primary must promote from an
+        # honest pool, and a dead witness shields nothing.
+        self.witness_timeout_s = witness_timeout_s
+        self.witness_error_threshold = witness_error_threshold
+        self.demoted_witnesses: List[Provider] = []
+        self.on_witness_demoted = on_witness_demoted
+        self._witness_errors: Dict[int, int] = {}  # id(provider) -> consecutive errors
         self.log = get_logger("lite2")
         self._initialized = False
 
@@ -175,29 +188,42 @@ class Client:
         if existing is not None:
             return existing
         latest_trusted_h = self.store.latest_height()
-        # Remember what was trusted before this pass: if a witness reveals a
-        # lying primary, every header the pass persisted must be rolled back
-        # — the reference only keeps state that survived witness comparison
+        # Track exactly what THIS pass persisted: if a witness reveals a
+        # lying primary, every header the pass added must be rolled back —
+        # the reference only keeps state that survived witness comparison
         # (client.go:505-512); serving poisoned headers from the store on
-        # later calls would defeat the cross-check entirely.
-        before = set(self.store.heights())
-        if height < self.store.first_height():
-            sh = await self._backwards(height, now)
-        elif height <= latest_trusted_h:
-            sh = await self._backwards(height, now)
-        elif self.mode == SEQUENCE:
-            sh = await self._sequence(height, now)
-        else:
-            sh = await self._bisection(height, now)
+        # later calls would defeat the cross-check entirely.  A pass-local
+        # set (not a before-snapshot of the whole store) keeps concurrent
+        # passes isolated: the loser's rollback must not delete headers a
+        # concurrent winner legitimately persisted in the meantime.
+        saved: Set[int] = set()
         try:
+            if height < self.store.first_height():
+                sh = await self._backwards(height, now, saved)
+            elif height <= latest_trusted_h:
+                sh = await self._backwards(height, now, saved)
+            elif self.mode == SEQUENCE:
+                sh = await self._sequence(height, now, saved)
+            else:
+                sh = await self._bisection(height, now, saved)
             await self._compare_with_witnesses(sh)
         except DivergedHeaderError:
-            for h in self.store.heights():
-                if h not in before:
-                    self.store.delete(h)
+            # a strategy-phase divergence (backwards hash-chain break) rolls
+            # back exactly like a witness-phase one: nothing a lying primary
+            # served this pass may survive in the store
+            for h in saved:
+                self.store.delete(h)
             raise
         self._prune()
         return sh
+
+    def _persist(self, sh: SignedHeader, vals: ValidatorSet, saved: Optional[Set[int]]) -> None:
+        """Save a verified pair, recording the height in the pass-local
+        `saved` set ONLY if this pass actually inserted it (a height that
+        was already present belongs to whichever pass put it there)."""
+        if saved is not None and self.store.signed_header(sh.height) is None:
+            saved.add(sh.height)
+        self.store.save_signed_header_and_validator_set(sh, vals)
 
     async def verify_header(self, sh: SignedHeader, vals: ValidatorSet, now_ns=None) -> None:
         """Verify a caller-supplied header (client.go:585 VerifyHeader)."""
@@ -234,7 +260,7 @@ class Client:
 
     # -- verification strategies ------------------------------------------
 
-    async def _sequence(self, height: int, now: int) -> SignedHeader:
+    async def _sequence(self, height: int, now: int, saved: Optional[Set[int]] = None) -> SignedHeader:
         """lite2/client.go:621 — verify every header one by one."""
         trusted_sh = self.store.signed_header(self.store.latest_height())
         for h in range(trusted_sh.height + 1, height + 1):
@@ -245,11 +271,11 @@ class Client:
                 self.trust_options.period_ns, now, self.max_clock_drift_ns,
                 batch_verify=await self._bv(sh, [vals]),
             )
-            self.store.save_signed_header_and_validator_set(sh, vals)
+            self._persist(sh, vals, saved)
             trusted_sh = sh
         return trusted_sh
 
-    async def _bisection(self, height: int, now: int) -> SignedHeader:
+    async def _bisection(self, height: int, now: int, saved: Optional[Set[int]] = None) -> SignedHeader:
         """lite2/client.go:688 — skipping verification with binary descent:
         try to jump straight to the target on trust-level power; if the
         trusted set's power at the target is insufficient, bisect."""
@@ -257,8 +283,23 @@ class Client:
         trusted_sh = self.store.signed_header(t_h)
         trusted_vals = self.store.validator_set(t_h)
 
-        target_sh = await self.primary.signed_header(height)
-        target_vals = await self.primary.validator_set(height)
+        # Per-pass fetch memo: the descent revisits the same pivots as the
+        # trusted base advances (and always snaps back to the target), so
+        # without this a byzantine primary that forces a deep descent buys
+        # O(heights × retries) redundant round-trips for the same data.
+        fetched: Dict[int, Tuple[SignedHeader, ValidatorSet]] = {}
+
+        async def fetch(h: int) -> Tuple[SignedHeader, ValidatorSet]:
+            pair = fetched.get(h)
+            if pair is None:
+                pair = (
+                    await self.primary.signed_header(h),
+                    await self.primary.validator_set(h),
+                )
+                fetched[h] = pair
+            return pair
+
+        target_sh, target_vals = await fetch(height)
         untrusted_sh, untrusted_vals = target_sh, target_vals
 
         for _ in range(1000):  # loop guard vs a byzantine primary
@@ -281,7 +322,7 @@ class Client:
                 except ErrNewValSetCantBeTrusted:
                     verified = False
             if verified:
-                self.store.save_signed_header_and_validator_set(untrusted_sh, untrusted_vals)
+                self._persist(untrusted_sh, untrusted_vals, saved)
                 trusted_sh, trusted_vals = untrusted_sh, untrusted_vals
                 if untrusted_sh.height == height:
                     return untrusted_sh
@@ -290,11 +331,10 @@ class Client:
                 pivot = (trusted_sh.height + untrusted_sh.height) // 2
                 if pivot == trusted_sh.height:
                     raise LightClientError("bisection cannot make progress")
-                untrusted_sh = await self.primary.signed_header(pivot)
-                untrusted_vals = await self.primary.validator_set(pivot)
+                untrusted_sh, untrusted_vals = await fetch(pivot)
         raise LightClientError("bisection exceeded iteration bound")
 
-    async def _backwards(self, height: int, now: int) -> SignedHeader:
+    async def _backwards(self, height: int, now: int, saved: Optional[Set[int]] = None) -> SignedHeader:
         """lite2/client.go:884 — walk the LastBlockID hash-chain down from
         the closest trusted header above `height`."""
         above = None
@@ -311,31 +351,76 @@ class Client:
         while cur.height > height:
             sh = await self.primary.signed_header(cur.height - 1)
             if sh.header.hash() != cur.header.last_block_id.hash:
-                raise LightClientError(
-                    f"hash chain broken at height {sh.height}: "
-                    f"{sh.header.hash().hex()} != {cur.header.last_block_id.hash.hex()}"
-                )
+                # the primary contradicts the already-trusted chain: that is
+                # a divergence (witness_idx -1 = caught without a witness),
+                # so callers route it through the same demote-the-primary
+                # recovery as a witness-detected fork
+                raise DivergedHeaderError(sh.height, -1)
             vals = await self.primary.validator_set(sh.height)
             if sh.header.validators_hash != vals.hash():
                 raise LightClientError("validators don't match header at backwards step")
-            self.store.save_signed_header_and_validator_set(sh, vals)
+            self._persist(sh, vals, saved)
             cur = sh
         return cur
 
     # -- witness cross-check + primary replacement ------------------------
 
     async def _compare_with_witnesses(self, sh: SignedHeader) -> None:
-        """lite2/client.go:932 compareNewHeaderWithWitnesses."""
-        for i, w in enumerate(self.witnesses):
-            try:
-                alt = await w.signed_header(sh.height)
-            except ProviderError:
-                continue  # witness lagging is not evidence of a fork
-            if alt.header.hash() != sh.header.hash():
-                raise DivergedHeaderError(sh.height, i)
+        """lite2/client.go:932 compareNewHeaderWithWitnesses — all
+        witnesses are queried CONCURRENTLY with a per-witness timeout, so
+        one hung witness delays a verification by at most
+        `witness_timeout_s` instead of stalling every other cross-check
+        behind it.  Errors are scored per witness; `witness_error_threshold`
+        consecutive failures demote the witness out of the active pool."""
+        witnesses = list(self.witnesses)
+        if not witnesses:
+            return
+
+        async def ask(w: Provider):
+            return await asyncio.wait_for(
+                w.signed_header(sh.height), timeout=self.witness_timeout_s
+            )
+
+        results = await asyncio.gather(*(ask(w) for w in witnesses), return_exceptions=True)
+        diverged: Optional[int] = None
+        for i, res in enumerate(results):
+            w = witnesses[i]
+            if isinstance(res, (ProviderError, asyncio.TimeoutError)):
+                # witness lagging is not evidence of a fork — but it IS
+                # evidence of a bad witness once it keeps happening
+                self._note_witness_error(w, res)
+                continue
+            if isinstance(res, BaseException):
+                raise res
+            self._witness_errors.pop(id(w), None)
+            if res.header.hash() != sh.header.hash():
+                if diverged is None:
+                    diverged = i
+        if diverged is not None:
+            raise DivergedHeaderError(sh.height, diverged)
+
+    def _note_witness_error(self, w: Provider, err: BaseException) -> None:
+        n = self._witness_errors.get(id(w), 0) + 1
+        self._witness_errors[id(w)] = n
+        if n < self.witness_error_threshold:
+            return
+        # demote: out of the active pool (so replace_primary never promotes
+        # a dead provider), kept on the demoted list for the operator
+        try:
+            self.witnesses.remove(w)
+        except ValueError:
+            pass
+        self.demoted_witnesses.append(w)
+        self._witness_errors.pop(id(w), None)
+        self.log.info(
+            "demoted witness", witness=type(w).__name__, errors=n, last_err=repr(err)
+        )
+        if self.on_witness_demoted is not None:
+            self.on_witness_demoted(w)
 
     async def replace_primary(self) -> None:
-        """lite2/client.go:1037 replaceProvider: promote the first witness."""
+        """lite2/client.go:1037 replaceProvider: promote the first ACTIVE
+        witness (demoted ones are no longer in the pool)."""
         if not self.witnesses:
             raise LightClientError("no witnesses left to replace the primary with")
         self.primary = self.witnesses.pop(0)

@@ -14,18 +14,29 @@ from typing import Optional
 from aiohttp import web
 
 from ..libs.log import get_logger
-from ..rpc.jsonrpc import INTERNAL_ERROR, INVALID_PARAMS, RPCError, make_response
+from ..rpc.jsonrpc import (
+    INTERNAL_ERROR,
+    INVALID_PARAMS,
+    RPCError,
+    make_response,
+    read_bounded_body,
+)
 from .client import BISECTION, Client, TrustOptions
 from .provider import HTTPProvider
+
+#: same default budget as RPCConfig.max_body_bytes — a light proxy faces
+#: the same untrusted clients a full node's RPC does
+DEFAULT_MAX_BODY_BYTES = 1_000_000
 
 
 class LightProxy:
     """Wraps a lite2.Client + the primary's RPC client; exposes verified
     routes over HTTP JSON-RPC (GET URI + POST envelope)."""
 
-    def __init__(self, client: Client, laddr: str):
+    def __init__(self, client: Client, laddr: str, max_body_bytes: int = DEFAULT_MAX_BODY_BYTES):
         self.client = client
         self.laddr = laddr
+        self.max_body_bytes = max_body_bytes
         self.log = get_logger("lite2.proxy")
         self._runner: Optional[web.AppRunner] = None
         self.listen_addr = ""
@@ -114,10 +125,20 @@ class LightProxy:
     async def _handle_post(self, request: web.Request) -> web.Response:
         from ..rpc.jsonrpc import from_jsonable
 
+        # bounded read BEFORE json.loads — the lite proxy rides the same
+        # discipline as the full node's RPC ingress (rpc/server.py)
         try:
-            req = json.loads(await request.read())
-        except ValueError:
+            body = await read_bounded_body(request, self.max_body_bytes)
+        except RPCError as e:
+            return web.json_response(make_response(None, error=e))
+        try:
+            req = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
             return web.json_response(make_response(None, error=RPCError(-32700, "bad JSON")))
+        if not isinstance(req, dict):
+            return web.json_response(
+                make_response(None, error=RPCError(-32600, "malformed request"))
+            )
         params = from_jsonable(req.get("params") or {})
         return web.json_response(await self._dispatch(req.get("method", ""), params, req.get("id")))
 

@@ -146,6 +146,7 @@ class Node(Service):
         self.pex_reactor = None
         self.metrics_provider = None
         self.metrics_server = None
+        self.liteserve = None
         self.grpc_server = None
         self.loop_profiler = None
         self.watchdog = None
@@ -652,6 +653,12 @@ class Node(Service):
             self.log.info("prometheus metrics", laddr=self.metrics_server.bound_addr)
         if self.loop_profiler is not None:
             self._register_queue_probes()
+        # embedded light-client gateway: lite_* routes served off this
+        # node's own engine — the LocalProvider primary reads the node's
+        # stores in-proc, and cache misses verify through the node's
+        # shared AsyncBatchVerifier lane instead of a private batch
+        if cfg.liteserve.enable:
+            await self._start_liteserve()
         # health watchdog, started LAST so every probed subsystem exists;
         # serves /health and the /status health block, emits
         # health.alarm/clear recorder events, auto-bundles on critical
@@ -690,6 +697,61 @@ class Node(Service):
             chain_id=self.genesis_doc.chain_id,
             height=self.state.last_block_height,
         )
+
+    async def _start_liteserve(self) -> None:
+        from .lite2 import HTTPProvider, LocalProvider, TrustOptions
+        from .liteserve import LiteServe, trust_root_from_rpc
+
+        cfg = self.config
+        ls = cfg.liteserve
+        primary = LocalProvider(self)
+        if ls.trust_height > 0 and ls.trust_hash:
+            root = TrustOptions(
+                int(ls.trust_period * 1e9), ls.trust_height, bytes.fromhex(ls.trust_hash)
+            )
+        else:
+            # embedded dev convenience: root at our own near-tip header —
+            # the gateway's subjective root IS this node's chain.  At boot
+            # the chain may still be at height 0; wait for the first commit
+            root = None
+            for _ in range(100):
+                try:
+                    root = await trust_root_from_rpc(primary)
+                    break
+                except Exception:  # noqa: BLE001 — no header yet
+                    await asyncio.sleep(0.1)
+            if root is None:
+                root = await trust_root_from_rpc(primary)
+        chain_id = self.genesis_doc.chain_id
+        witnesses = [
+            HTTPProvider(chain_id, w.strip())
+            for w in ls.witnesses.split(",") if w.strip()
+        ]
+        self.liteserve = LiteServe(
+            chain_id,
+            root,
+            primary,
+            witnesses,
+            laddr=ls.laddr,
+            cache_capacity=ls.cache_capacity,
+            max_sessions=ls.max_sessions,
+            idle_timeout_s=ls.idle_timeout,
+            session_rate=ls.session_rate,
+            session_burst=ls.session_burst,
+            create_rate=ls.create_rate,
+            create_burst=ls.create_burst,
+            witness_quorum=ls.witness_quorum,
+            witness_timeout_s=ls.witness_timeout,
+            rotation_seed=ls.rotation_seed,
+            max_body_bytes=ls.max_body_bytes,
+            async_verifier=self.async_verifier,
+            metrics=self.metrics_provider.liteserve,
+            recorder=self.flight_recorder,
+            primary_addr="local",
+            witness_addrs=[w.strip() for w in ls.witnesses.split(",") if w.strip()],
+        )
+        await self.liteserve.start()
+        self.log.info("liteserve gateway", laddr=self.liteserve.listen_addr)
 
     async def _spool_flush_loop(self) -> None:
         """Cadence flush of the flight spool — small buffered appends, far
@@ -764,6 +826,8 @@ class Node(Service):
     async def on_stop(self) -> None:
         if self.watchdog is not None:
             await self.watchdog.stop()
+        if self.liteserve is not None:
+            await self.liteserve.stop()
         if self.loop_profiler is not None:
             await self.loop_profiler.stop()
         if self.metrics_server is not None:

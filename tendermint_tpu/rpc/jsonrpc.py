@@ -126,3 +126,22 @@ def parse_response(raw: str | bytes | dict) -> Any:
     if d.get("error"):
         raise RPCError.from_dict(d["error"])
     return from_jsonable(d.get("result"))
+
+
+async def read_bounded_body(request, limit: int) -> bytes:
+    """Bounded request-body read BEFORE parsing (http_server.go
+    maxBodyBytes): the content stream is read up to `limit` + 1 bytes
+    total — in a loop, because StreamReader.read(n) returns whatever chunk
+    is buffered, not n bytes — so a client streaming an arbitrarily large
+    body can never reach json.loads; it gets an explicit INVALID_REQUEST
+    naming the cap after one bounded buffer.  Shared by every HTTP
+    JSON-RPC ingress (rpc server, lite proxy, liteserve gateway)."""
+    body = b""
+    while len(body) <= limit:
+        chunk = await request.content.read(limit + 1 - len(body))
+        if not chunk:
+            break
+        body += chunk
+    if len(body) > limit:
+        raise RPCError(INVALID_REQUEST, f"request body exceeds {limit} bytes")
+    return body

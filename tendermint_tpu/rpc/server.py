@@ -30,6 +30,7 @@ from .jsonrpc import (
     RPCError,
     from_jsonable,
     make_response,
+    read_bounded_body,
 )
 
 
@@ -107,28 +108,10 @@ class RPCServer(Service):
     # -- HTTP POST: JSON-RPC (single or batch) ----------------------------
 
     async def _handle_post(self, request: web.Request) -> web.Response:
-        # Bounded read BEFORE parsing (http_server.go maxBodyBytes): the
-        # raw content stream is read up to max_body_bytes + 1 total — in a
-        # loop, because StreamReader.read(n) returns whatever chunk is
-        # buffered, not n bytes — so a client streaming an arbitrarily
-        # large body can never reach json.loads; it gets an explicit
-        # rejection after one bounded buffer.
-        limit = self.cfg.max_body_bytes
-        body = b""
-        while len(body) <= limit:
-            chunk = await request.content.read(limit + 1 - len(body))
-            if not chunk:
-                break
-            body += chunk
-        if len(body) > limit:
-            return web.json_response(
-                make_response(
-                    None,
-                    error=RPCError(
-                        INVALID_REQUEST, f"request body exceeds {limit} bytes"
-                    ),
-                )
-            )
+        try:
+            body = await read_bounded_body(request, self.cfg.max_body_bytes)
+        except RPCError as e:
+            return web.json_response(make_response(None, error=e))
         try:
             payload = json.loads(body)
         except (ValueError, UnicodeDecodeError):

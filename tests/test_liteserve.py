@@ -1,0 +1,431 @@
+"""liteserve gateway tests: shared verification cache (hit / miss /
+single-flight coalescing / LRU), witness-diversity rotation + demotion +
+promotion, bounded session table with explicit overload, and the service
+end to end over HTTP — including the adversarial-primary scenario: a
+lying primary is detected via witness cross-check, demoted, replaced by a
+promoted witness, and nothing it served survives in the shared store.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from test_lite2 import CHAIN, PERIOD, SEC, T0, make_chain, rand_vset, _commit
+
+from tendermint_tpu.lite2 import Client, MemStore, MockProvider, TrustOptions
+from tendermint_tpu.rpc.jsonrpc import RPCError, SERVER_OVERLOADED
+from tendermint_tpu.liteserve import (
+    LiteServe,
+    SessionManager,
+    VerifyCache,
+    WitnessPool,
+)
+from tendermint_tpu.types import BlockID, Header, PartSetHeader, SignedHeader
+
+
+def now_at(h):
+    return lambda: T0 + h * SEC
+
+
+def mk_client(headers, vals, height=1, witnesses=(), store=None, **kw):
+    primary = MockProvider(CHAIN, headers, vals)
+    return Client(
+        CHAIN,
+        TrustOptions(PERIOD, height, headers[height].header.hash()),
+        primary,
+        witnesses=list(witnesses),
+        store=store or MemStore(),
+        now_fn=now_at(max(headers) + 1),
+        **kw,
+    )
+
+
+def forge_conflicting(headers, vals_map, pvs, height):
+    """A twin-style conflicting header at `height`: same chain position,
+    same validator set, different app_hash — re-committed by the same
+    signers (what a lying primary backed by compromised keys serves)."""
+    real = headers[height].header
+    forged = Header(
+        chain_id=real.chain_id,
+        height=real.height,
+        time_ns=real.time_ns,
+        last_block_id=real.last_block_id,
+        validators_hash=real.validators_hash,
+        next_validators_hash=real.next_validators_hash,
+        proposer_address=real.proposer_address,
+        app_hash=b"\xde\xad" * 16,
+    )
+    vset = vals_map[height]
+    bid = BlockID(forged.hash(), PartSetHeader(1, forged.hash()))
+    commit = _commit(vset, pvs, height, bid)
+    return SignedHeader(forged, commit)
+
+
+# -- VerifyCache -----------------------------------------------------------
+
+
+class TestVerifyCache:
+    @pytest.fixture()
+    def chain(self):
+        vset, pvs = rand_vset(4)
+        headers, vals = make_chain(12, {1: (vset, pvs)})
+        return headers, vals, pvs
+
+    def test_miss_then_hit(self, chain):
+        headers, vals, _ = chain
+        cache = VerifyCache(capacity=8)
+
+        async def run():
+            sh = headers[5]
+            lookup = await cache._preverify(sh, [vals[5]])
+            assert cache.misses == 1 and cache.hits == 0
+            # the verdict map answers the sync path's exact batch
+            items = [
+                (vals[5].validators[i].pub_key.bytes(),
+                 sh.commit.vote_sign_bytes(CHAIN, i),
+                 sh.commit.signatures[i].signature)
+                for i in range(vals[5].size())
+            ]
+            assert lookup(*map(list, zip(*items))) == [True] * len(items)
+            await cache._preverify(sh, [vals[5]])
+            assert cache.hits == 1
+
+        asyncio.run(run())
+
+    def test_coalesce_concurrent_same_key(self, chain):
+        headers, vals, _ = chain
+        cache = VerifyCache(capacity=8)
+
+        async def run():
+            sh = headers[3]
+            await asyncio.gather(*(
+                cache._preverify(sh, [vals[3]]) for _ in range(6)
+            ))
+            # one real verification; the rest either coalesced onto the
+            # in-flight future or hit the already-populated entry
+            assert cache.misses == 1
+            assert cache.coalesced + cache.hits == 5
+
+        asyncio.run(run())
+
+    def test_lru_eviction(self, chain):
+        headers, vals, _ = chain
+
+        async def run():
+            cache = VerifyCache(capacity=2)
+            for h in (1, 2, 3):
+                await cache._preverify(headers[h], [vals[h]])
+            assert len(cache._lru) == 2 and cache.evictions == 1
+            # height 1 was evicted: asking again is a miss, not a hit
+            await cache._preverify(headers[1], [vals[1]])
+            assert cache.misses == 4
+
+        asyncio.run(run())
+
+    def test_digest_guard_rejects_different_commit(self, chain):
+        headers, vals, pvs = chain
+
+        async def run():
+            cache = VerifyCache(capacity=8)
+            sh = headers[4]
+            await cache._preverify(sh, [vals[4]])
+            # same header, different commit content (fewer signatures):
+            # must NOT be served the cached verdicts
+            twin = forge_conflicting(headers, vals, pvs, 4)
+            alt = SignedHeader(sh.header, twin.commit)
+            await cache._preverify(alt, [vals[4]])
+            assert cache.misses == 2
+
+        asyncio.run(run())
+
+
+# -- WitnessPool -----------------------------------------------------------
+
+
+class TestWitnessPool:
+    def test_rotation_is_seeded_and_spreads(self):
+        pool = WitnessPool(seed=7, quorum=2)
+        provs = [MockProvider(CHAIN) for _ in range(5)]
+        for i, p in enumerate(provs):
+            pool.add(p, addr=f"w{i}")
+        seen = set()
+        for _ in range(40):
+            subset = pool.select()
+            assert len(subset) == 2
+            seen.update(id(p) for p in subset)
+        assert len(seen) == 5  # every witness participates over time
+        # deterministic under the same seed: two pools pick identically
+        p1 = WitnessPool(seed=7, quorum=2)
+        p2 = WitnessPool(seed=7, quorum=2)
+        for i, p in enumerate(provs):
+            p1.add(p, addr=f"w{i}")
+            p2.add(p, addr=f"w{i}")
+        for _ in range(10):
+            assert [id(x) for x in p1.select()] == [id(x) for x in p2.select()]
+
+    def test_error_scoring_demotes_at_threshold(self):
+        pool = WitnessPool(quorum=2, error_threshold=3)
+        a, b = MockProvider(CHAIN), MockProvider(CHAIN)
+        pool.add(a, addr="a")
+        pool.add(b, addr="b")
+        assert not pool.report_error(a)
+        assert not pool.report_error(a)
+        pool.report_ok(a)  # success resets the consecutive count
+        assert not pool.report_error(a)
+        assert not pool.report_error(a)
+        assert pool.report_error(a)  # third consecutive: demoted
+        assert pool.providers() == [b]
+        assert pool.total_demotions == 1
+        pool.restore(a)
+        assert a in pool.providers()
+
+    def test_promote_prefers_clean_witness(self):
+        pool = WitnessPool(quorum=2)
+        a, b = MockProvider(CHAIN), MockProvider(CHAIN)
+        pool.add(a, addr="a")
+        pool.add(b, addr="b")
+        pool.report_error(a)
+        assert pool.promote() is b
+        assert pool.providers() == [a]  # the promoted one left the pool
+        pool.demote(a)
+        with pytest.raises(LookupError):
+            pool.promote()
+
+
+# -- SessionManager --------------------------------------------------------
+
+
+class TestSessionManager:
+    def test_create_validates_root(self):
+        mgr = SessionManager()
+        with pytest.raises(RPCError):
+            mgr.create("1.2.3.4", 0, b"\x00" * 32)
+        with pytest.raises(RPCError):
+            mgr.create("1.2.3.4", 5, b"short")
+
+    def test_table_bound_explicit_overload(self):
+        mgr = SessionManager(max_sessions=2, idle_timeout_s=3600)
+        mgr.create("a", 1, b"\x01" * 32)
+        mgr.create("a", 1, b"\x01" * 32)
+        with pytest.raises(RPCError) as ei:
+            mgr.create("a", 1, b"\x01" * 32)
+        assert ei.value.code == SERVER_OVERLOADED
+        assert ei.value.data and "retry_after" in ei.value.data
+
+    def test_full_table_evicts_idle_first(self):
+        mgr = SessionManager(max_sessions=2, idle_timeout_s=0.0)
+        s1 = mgr.create("a", 1, b"\x01" * 32)
+        mgr.create("a", 1, b"\x01" * 32)
+        s3 = mgr.create("a", 1, b"\x01" * 32)  # evicts the idle ones
+        assert s3.sid in mgr.sessions and s1.sid not in mgr.sessions
+        assert mgr.evicted_total >= 1
+
+    def test_create_rate_limit_per_source(self):
+        mgr = SessionManager(create_rate=1.0, create_burst=2)
+        mgr.create("spammer", 1, b"\x01" * 32)
+        mgr.create("spammer", 1, b"\x01" * 32)
+        with pytest.raises(RPCError) as ei:
+            mgr.create("spammer", 1, b"\x01" * 32)
+        assert ei.value.code == SERVER_OVERLOADED
+        # a different source has its own bucket
+        mgr.create("friend", 1, b"\x01" * 32)
+
+    def test_session_request_bucket(self):
+        mgr = SessionManager(session_rate=1.0, session_burst=2)
+        s = mgr.create("a", 1, b"\x01" * 32)
+        s.admit()
+        s.admit()
+        with pytest.raises(RPCError) as ei:
+            s.admit()
+        assert ei.value.code == SERVER_OVERLOADED
+
+    def test_resume_unknown_session(self):
+        mgr = SessionManager()
+        with pytest.raises(RPCError):
+            mgr.resume("nope")
+
+
+# -- service end to end ----------------------------------------------------
+
+
+def mk_service(headers, vals, n_witnesses=3, primary=None, **kw):
+    witnesses = [MockProvider(CHAIN, headers, vals) for _ in range(n_witnesses)]
+    return LiteServe(
+        CHAIN,
+        TrustOptions(PERIOD, 1, headers[1].header.hash()),
+        primary or MockProvider(CHAIN, headers, vals),
+        witnesses,
+        laddr="tcp://127.0.0.1:0",
+        now_fn=now_at(max(headers) + 1),
+        witness_timeout_s=0.5,
+        witness_addrs=[f"w{i}" for i in range(n_witnesses)],
+        primary_addr="primary",
+        **kw,
+    )
+
+
+async def rpc(base, method, **params):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            f"http://{base}/", data=json.dumps(
+                {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+            )
+        ) as resp:
+            return await resp.json()
+
+
+class TestLiteServeService:
+    @pytest.fixture()
+    def chain(self):
+        vset, pvs = rand_vset(4)
+        headers, vals = make_chain(16, {1: (vset, pvs)})
+        return headers, vals, pvs
+
+    def test_sessions_share_one_engine(self, chain):
+        headers, vals, _ = chain
+
+        async def run():
+            svc = mk_service(headers, vals)
+            await svc.start()
+            try:
+                base = svc.listen_addr
+                root = headers[2].header.hash().hex()
+                sids = []
+                for _ in range(4):
+                    res = await rpc(
+                        base, "lite_session_new", trust_height=2, trust_hash=root
+                    )
+                    sids.append(res["result"]["session"])
+                # all four tenants ask about the same height: one store
+                # miss total, the rest request-level hits
+                outs = await asyncio.gather(*(
+                    rpc(base, "lite_commit", session=sid, height=9) for sid in sids
+                ))
+                assert all("result" in o for o in outs)
+                status = (await rpc(base, "lite_status"))["result"]
+                assert status["verify"]["hits"] >= 3
+                assert status["verify"]["hit_ratio"] > 0.5
+                assert status["sessions"]["sessions"] == 4
+                # resume works; a bogus session errors
+                res = await rpc(base, "lite_session_resume", session=sids[0])
+                assert res["result"]["session"] == sids[0]
+                res = await rpc(base, "lite_commit", session="bogus", height=3)
+                assert "error" in res
+            finally:
+                await svc.stop()
+
+        asyncio.run(run())
+
+    def test_bad_trust_root_rejected(self, chain):
+        headers, vals, _ = chain
+
+        async def run():
+            svc = mk_service(headers, vals)
+            await svc.start()
+            try:
+                res = await rpc(
+                    svc.listen_addr, "lite_session_new",
+                    trust_height=2, trust_hash="ab" * 32,
+                )
+                assert "error" in res and "conflicts" in res["error"]["message"]
+                assert len(svc.sessions.sessions) == 0
+            finally:
+                await svc.stop()
+
+        asyncio.run(run())
+
+    def test_concurrent_same_height_coalesce(self, chain):
+        headers, vals, _ = chain
+
+        class SlowProvider(MockProvider):
+            # MockProvider never suspends, so without this the first task
+            # would finish the whole pass before the others even start
+            async def signed_header(self, height):
+                await asyncio.sleep(0.002)
+                return await super().signed_header(height)
+
+        async def run():
+            svc = mk_service(
+                headers, vals, primary=SlowProvider(CHAIN, headers, vals)
+            )
+            await svc.start()
+            try:
+                await asyncio.gather(*(
+                    svc.verified_header(12) for _ in range(8)
+                ))
+                assert svc.lookup_misses == 1
+                assert svc.coalesced_requests >= 1
+                assert svc.lookup_misses + svc.lookup_hits + svc.coalesced_requests == 8
+            finally:
+                await svc.stop()
+
+        asyncio.run(run())
+
+    def test_adversarial_primary_demoted_and_replaced(self, chain):
+        headers, vals, pvs = chain
+        twin = forge_conflicting(headers, vals, pvs, 10)
+        evil_headers = dict(headers)
+        evil_headers[10] = twin
+        evil = MockProvider(CHAIN, evil_headers, vals)
+
+        async def run():
+            svc = mk_service(headers, vals, primary=evil)
+            await svc.start()
+            try:
+                base = svc.listen_addr
+                root = headers[2].header.hash().hex()
+                good = (await rpc(
+                    base, "lite_session_new", trust_height=2, trust_hash=root
+                ))["result"]["session"]
+                # an unaffected tenant working below the forged height
+                res = await rpc(base, "lite_commit", session=good, height=5)
+                assert "result" in res
+                # the forged height: witness cross-check detects the
+                # divergence, the primary is demoted and a witness
+                # promoted — the request still SUCCEEDS, on real data
+                res = await rpc(base, "lite_commit", session=good, height=10)
+                assert "result" in res
+                assert svc.diverged_detected >= 1
+                assert svc.primary_replacements == 1
+                assert svc.client.primary is not evil
+                # the shared store holds the REAL header, and nothing the
+                # lying primary served survived anywhere
+                assert svc.store.signed_header(10).header.hash() \
+                    == headers[10].header.hash()
+                for h in svc.store.heights():
+                    assert svc.store.signed_header(h).header.hash() \
+                        == headers[h].header.hash()
+                # service keeps serving other tenants afterwards
+                res = await rpc(base, "lite_commit", session=good, height=14)
+                assert "result" in res
+                status = (await rpc(base, "lite_status"))["result"]
+                assert status["verify"]["primary_replacements"] == 1
+                assert status["verify"]["demoted_primaries"] == ["primary"]
+            finally:
+                await svc.stop()
+
+        asyncio.run(run())
+
+    def test_overload_surfaces_minus_32005(self, chain):
+        headers, vals, _ = chain
+
+        async def run():
+            svc = mk_service(headers, vals, max_sessions=1)
+            await svc.start()
+            try:
+                base = svc.listen_addr
+                root = headers[2].header.hash().hex()
+                res = await rpc(
+                    base, "lite_session_new", trust_height=2, trust_hash=root
+                )
+                assert "result" in res
+                res = await rpc(
+                    base, "lite_session_new", trust_height=2, trust_hash=root
+                )
+                assert res["error"]["code"] == SERVER_OVERLOADED
+            finally:
+                await svc.stop()
+
+        asyncio.run(run())
