@@ -1,7 +1,7 @@
 # Build/test/bench entry points (reference parity: Makefile).
 PY ?= python
 
-.PHONY: test test-fast bench bench-smoke mesh-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke disk-smoke scale-smoke bls-smoke bls-ext load-smoke lite-smoke forensics-smoke finality-smoke localnet lint fmt csrc clean abci-cli signer-harness
+.PHONY: test test-fast bench bench-smoke mesh-smoke trace-smoke trace-net-smoke statesync-smoke chaos-smoke disk-smoke scale-smoke bls-smoke bls-ext load-smoke lite-smoke forensics-smoke finality-smoke rotation-smoke localnet lint fmt csrc clean abci-cli signer-harness
 
 test:            ## full suite (virtual 8-device CPU mesh)
 	$(PY) -m pytest tests/ -q
@@ -49,6 +49,9 @@ scale-smoke:     ## 100-validator in-proc net (engine ON, relay gossip): >=10 co
 bls-smoke:       ## BLS12-381 localnet: every stored commit must be ONE aggregate signature + bitmap (C pairing tier asserted engaged when a toolchain exists); empty joiner fastsyncs over them
 	$(PY) networks/local/bls_smoke.py --json
 	rm -rf build-bls
+
+rotation-smoke:  ## dynamic validator sets: staking-driven 4→7→6 growth, partition+twin across a set change, epoch barrel-shift, live ed25519→BLS migration (aggregation engages AND disengages), fastsync + lite2 bisection over the rotated history, zero checker violations
+	$(PY) networks/local/rotation_smoke.py --json
 
 bls-ext:         ## prebuild the BLS12-381 C pairing tier (.so) so suite/node runs don't pay the compile; fails without a working toolchain
 	$(PY) -c "from tendermint_tpu.crypto.bls import ctier; import sys; sys.exit(0 if ctier.available() else 1)"

@@ -450,6 +450,33 @@ def bench_scale_100val():
     return json.loads(run.stdout.strip().splitlines()[-1])
 
 
+def bench_rotation():
+    """Dynamic validator sets measured live: run the rotation rig
+    (networks/local/rotation_smoke.py — a 7-node staking-app net that
+    grows 4→7 validators through real bond txs with a partition and a
+    twin double-signer ACROSS the set change, observes the epoch
+    barrel-shift, votes the halted twin out, live-migrates every
+    validator ed25519→BLS12-381 and back one, fastsyncs a fresh node and
+    bisects a lite2 client over the rotated history) and report
+    `valset_update_latency_ms` (stake-tx submit → set effective),
+    `bls_migration_height_gap` (set uniformity → first stored
+    AggregateCommit) and `lite2_skip_across_rotation_ok`.  Any invariant
+    violation or missing engine table rebuild fails the smoke, not just
+    the bench."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    run = subprocess.run(
+        [sys.executable, os.path.join(repo, "networks", "local", "rotation_smoke.py"),
+         "--json"],
+        capture_output=True, text=True, timeout=1800, cwd=repo,
+    )
+    if run.returncode != 0:
+        raise RuntimeError(f"rotation smoke failed:\n{run.stdout[-2000:]}\n{run.stderr[-2000:]}")
+    return json.loads(run.stdout.strip().splitlines()[-1])
+
+
 def bench_mesh_scaling():
     """Sharded verify engine over 8 virtual CPU devices
     (networks/local/mesh_smoke.py): bit-identical verdicts vs the
@@ -1012,6 +1039,10 @@ def main() -> None:
     except Exception as e:
         mesh = {"sharded_sigs_per_sec": -1.0, "error": str(e)[:300]}
     try:
+        rotation = bench_rotation()
+    except Exception as e:
+        rotation = {"valset_update_latency_ms": -1.0, "error": str(e)[:300]}
+    try:
         forensics = bench_forensics()
     except Exception as e:
         forensics = {"crash_bundle_completeness": -1.0, "error": str(e)[:300]}
@@ -1081,6 +1112,13 @@ def main() -> None:
         "finality_under_load_p50_ms": finality.get("finality_under_load_p50_ms", -1.0),
         "finality_budget_pipelined": finality.get("budget_pipelined"),
         "finality_budget_serial": finality.get("budget_serial"),
+        "valset_update_latency_ms": rotation.get("valset_update_latency_ms", -1.0),
+        "bls_migration_height_gap": rotation.get("bls_migration_height_gap", -1),
+        "lite2_skip_across_rotation_ok": rotation.get(
+            "lite2_skip_across_rotation_ok", False
+        ),
+        "rotation_epoch_observed": rotation.get("epoch_rotation_observed"),
+        "rotation_table_rebuild_events": rotation.get("table_rebuild_events"),
         "chaos_partition_recovery_ms": chaos.get("chaos_partition_recovery_ms", -1.0),
         "chaos_restart_recovery_ms": chaos.get("restart_recovery_ms"),
         "chaos_evidence_height": chaos.get("evidence_height"),

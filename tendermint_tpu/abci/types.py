@@ -33,6 +33,11 @@ class ValidatorUpdate:
     pub_key_type: str = "ed25519"
     pub_key: bytes = b""
     power: int = 0
+    # BLS12-381 keys entering a live set MUST carry a proof of possession:
+    # FastAggregateVerify is rogue-key-sound only over PoP-checked keys, and
+    # genesis's PoP gate (types/genesis.py) doesn't see ABCI-driven joins.
+    # Ignored (and must be empty) for non-BLS key types.
+    pop: bytes = b""
 
 
 @dataclass

@@ -17,6 +17,10 @@ with seed 7, byte-identical fault schedule.
     disk 2 enospc @20~0.5
     disk 2 heal @26
     rot 1 blockstore h=3 @22
+    valset join 4 power=20 @24
+    valset power 1=50 @28
+    valset migrate 0 bls @30
+    valset leave 2 @34
 
 Grammar: clauses separated by `;` or newlines, `#` comments.  `@T`
 anchors the clause at T seconds from scenario start; `@T~J` jitters it
@@ -44,6 +48,21 @@ Actions:
     rot N STORE h=H [part=I]    persistent seeded bit-rot: flip one byte in
                                 node N's stored block part (height H); the
                                 integrity scan must detect + quarantine it
+    valset join N [power=P]     node N bonds into the validator set (stake
+                                tx signed with its privval key; default
+                                power 10)
+    valset leave N              node N unbonds out of the set entirely
+    valset power N=P            set node N's voting power to P outright
+    valset migrate N SCHEME     rotate node N's consensus key live to
+                                SCHEME in (bls|bls12381|ed25519) — the
+                                node must hold the target key already
+                                (RotatingPV candidate)
+
+The valset clauses are faults in the same sense as partitions: they
+mutate the validator set THROUGH the staking app's tx path (bond/edit/
+rotate), so every assumption downstream — verify-table identity, BLS
+aggregation uniformity, lite-client bisection — gets exercised exactly
+the way a production set change would exercise it.
 
 The executor (`ScenarioRunner`) drives any object satisfying the Rig
 surface; `InProcRig` adapts a list of in-process Nodes (the tier-1 path),
@@ -193,6 +212,53 @@ class Scenario:
                     events.append(
                         FaultEvent(t, "rot", {"node": node, "store": store, **kv}, clause)
                     )
+                elif action == "valset":
+                    if not args:
+                        raise ScenarioError(f"valset needs an op in {clause!r}")
+                    op = args[0]
+                    if op == "join":
+                        kv = {"op": "join", "node": int(args[1]), "power": 10}
+                        for a in args[2:]:
+                            k, v = a.split("=", 1)
+                            if k != "power":
+                                raise ScenarioError(f"unknown valset join key {k!r} in {clause!r}")
+                            kv["power"] = int(v)
+                        if kv["power"] <= 0:
+                            raise ScenarioError(f"valset join power must be > 0 in {clause!r}")
+                        events.append(FaultEvent(t, "valset", kv, clause))
+                    elif op == "leave":
+                        events.append(
+                            FaultEvent(t, "valset", {"op": "leave", "node": int(args[1])}, clause)
+                        )
+                    elif op == "power":
+                        node_s, power_s = args[1].split("=", 1)
+                        events.append(
+                            FaultEvent(
+                                t, "valset",
+                                {"op": "power", "node": int(node_s), "power": int(power_s)},
+                                clause,
+                            )
+                        )
+                    elif op == "migrate":
+                        scheme = args[2] if len(args) > 2 else "bls"
+                        if scheme not in ("bls", "bls12381", "ed25519"):
+                            raise ScenarioError(
+                                f"valset migrate scheme must be bls|bls12381|ed25519 "
+                                f"(got {scheme!r} in {clause!r})"
+                            )
+                        events.append(
+                            FaultEvent(
+                                t, "valset",
+                                {
+                                    "op": "migrate",
+                                    "node": int(args[1]),
+                                    "scheme": "bls12381" if scheme != "ed25519" else "ed25519",
+                                },
+                                clause,
+                            )
+                        )
+                    else:
+                        raise ScenarioError(f"unknown valset op {op!r} in {clause!r}")
                 else:
                     raise ScenarioError(f"unknown action {action!r} in {clause!r}")
             except (IndexError, ValueError) as e:
@@ -231,6 +297,7 @@ class ScenarioRunner:
         async set_skew(i, skew_s)
         async set_disk(i, store, kind, p) / heal_disk(i, store)
         async rot(i, store, height, part)
+        async valset(op, i, **kv)    op in join|leave|power|migrate
     """
 
     def __init__(self, scenario: Scenario, rig, recorder=None):
@@ -292,6 +359,9 @@ class ScenarioRunner:
             await self.rig.rot(
                 ev.args["node"], ev.args["store"], ev.args["height"], ev.args["part"]
             )
+        elif a == "valset":
+            kv = {k: v for k, v in ev.args.items() if k not in ("op", "node")}
+            await self.rig.valset(ev.args["op"], ev.args["node"], **kv)
         else:  # parse() already rejects unknown actions
             raise ScenarioError(f"unexecutable action {a!r}")
 
@@ -379,3 +449,97 @@ class InProcRig:
             self.nodes[i].block_store, height, seed=self._disk_table(i).seed, part_index=part
         )
         self.log.info("rot injected", node=i, height=height, **info)
+
+    # -- validator-set actions (staking-app tx path) -------------------------
+    #
+    # Requires proxy_app = "staking".  Every action is a real signed stake
+    # tx submitted through a running node's mempool — the set change then
+    # flows tx -> end_block.validator_updates -> update_state exactly like
+    # production, which is the point: no backdoor set surgery.
+
+    def _privval_keys(self, i: int):
+        """All candidate privkeys node i holds (RotatingPV-aware).  Also
+        unwraps TwinSigner (`._priv`) and FilePV (`.key.priv_key`) so a
+        twin's owner key can still sign stake txs — e.g. `valset leave`
+        for a halted equivocator."""
+        pv = getattr(self.nodes[i], "priv_validator", None)
+        out = []
+        for cand in getattr(pv, "candidates", None) or [pv]:
+            pk = (
+                getattr(cand, "priv_key", None)
+                or getattr(cand, "_priv", None)
+                or getattr(getattr(cand, "key", None), "priv_key", None)
+            )
+            if pk is not None:
+                out.append(pk)
+        return out
+
+    def _owner_key(self, i: int):
+        """Node i's ed25519 control key — the envelope signer for every
+        stake tx.  Stays fixed across consensus-key migrations (that
+        separation is what makes live migration possible)."""
+        for pk in self._privval_keys(i):
+            if getattr(pk.pub_key(), "TYPE", "") == "tendermint/PubKeyEd25519":
+                return pk
+        raise RuntimeError(f"node {i} has no ed25519 privval key to sign stake txs")
+
+    def _candidate_key(self, i: int, scheme: str):
+        want = (
+            "tendermint/PubKeyBLS12381" if scheme == "bls12381"
+            else "tendermint/PubKeyEd25519"
+        )
+        for pk in self._privval_keys(i):
+            if getattr(pk.pub_key(), "TYPE", "") == want:
+                return pk
+        raise RuntimeError(
+            f"node {i} holds no {scheme} consensus key — give it a RotatingPV "
+            f"with a {scheme} candidate before migrating"
+        )
+
+    def _submit_via(self, i: int):
+        """Prefer the target node's own mempool; any running node works
+        (gossip carries it) when the target is down or partitioned."""
+        if self.nodes[i].is_running:
+            return self.nodes[i]
+        for node in self.nodes:
+            if node.is_running:
+                return node
+        raise RuntimeError("no running node to submit a stake tx through")
+
+    async def _next_nonce(self, node, owner_addr: bytes) -> int:
+        from ..abci import types as abci
+
+        res = await node.proxy_app.query().query(
+            abci.RequestQuery(path="nonce", data=owner_addr)
+        )
+        return int(res.value or b"0")
+
+    async def valset(self, op: str, i: int, **kv) -> None:
+        from ..apps.staking import (
+            make_bond_tx,
+            make_edit_power_tx,
+            make_rotate_key_tx,
+        )
+
+        owner = self._owner_key(i)
+        via = self._submit_via(i)
+        nonce = await self._next_nonce(via, owner.pub_key().address())
+        if op == "join":
+            tx = make_bond_tx(owner, int(kv["power"]), nonce)
+        elif op == "leave":
+            tx = make_edit_power_tx(owner, 0, nonce)
+        elif op == "power":
+            tx = make_edit_power_tx(owner, int(kv["power"]), nonce)
+        elif op == "migrate":
+            scheme = kv["scheme"]
+            new_key = self._candidate_key(i, scheme)
+            pop = new_key.pop() if scheme == "bls12381" else b""
+            tx = make_rotate_key_tx(
+                owner, scheme, new_key.pub_key().bytes(), nonce, pop=pop
+            )
+        else:
+            raise RuntimeError(f"unknown valset op {op!r}")
+        res = await via.mempool.check_tx(tx)
+        if res.code != 0:
+            raise RuntimeError(f"valset {op} node {i}: stake tx rejected: {res.log}")
+        self.log.info("valset tx submitted", op=op, node=i, nonce=nonce)

@@ -180,16 +180,37 @@ class Handshaker:
 
         # genesis: tell the app about it
         if app_block_height == 0:
+            # per-validator key type (a BLS genesis must not be announced
+            # to the app as ed25519) + the genesis PoP so a staking-style
+            # app can round-trip the full update through end_block later
+            _ABCI_KEY_TYPE = {
+                "tendermint/PubKeyEd25519": "ed25519",
+                "tendermint/PubKeySr25519": "sr25519",
+                "tendermint/PubKeySecp256k1": "secp256k1",
+                "tendermint/PubKeyBLS12381": "bls12381",
+            }
             validators = [
-                abci.ValidatorUpdate("ed25519", v.pub_key.bytes(), v.power)
+                abci.ValidatorUpdate(
+                    _ABCI_KEY_TYPE.get(getattr(v.pub_key, "TYPE", ""), "ed25519"),
+                    v.pub_key.bytes(),
+                    v.power,
+                    pop=getattr(v, "pop", b"") or b"",
+                )
                 for v in self.genesis_doc.validators
             ]
+            app_state_bytes = b""
+            if self.genesis_doc.app_state is not None:
+                import json as _json
+
+                app_state_bytes = _json.dumps(
+                    self.genesis_doc.app_state, sort_keys=True
+                ).encode()
             req = abci.RequestInitChain(
                 time_ns=self.genesis_doc.genesis_time_ns,
                 chain_id=self.genesis_doc.chain_id,
                 consensus_params=self.genesis_doc.consensus_params.to_dict(),
                 validators=validators,
-                app_state_bytes=b"",
+                app_state_bytes=app_state_bytes,
             )
             res = await proxy_app.consensus().init_chain(req)
             if state_height == 0:  # only apply on a truly new chain

@@ -1565,6 +1565,17 @@ class ConsensusState(Service):
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
         self.sm_state = state
+        # live consensus-key migration: a multi-key privval (RotatingPV)
+        # selects whichever of its keys is a member of THIS height's set —
+        # notified here, at the exact height boundary where an ABCI-driven
+        # key rotation becomes effective, so the node never signs with a
+        # key the set no longer contains (or doesn't contain yet)
+        pv = self.priv_validator
+        if pv is not None and hasattr(pv, "observe_validators"):
+            try:
+                pv.observe_validators(state.validators)
+            except Exception as e:
+                self.log.error("privval observe_validators failed", err=repr(e))
 
     def _update_round_step(self, round_: int, step: int) -> None:
         self.rs.round = round_

@@ -223,6 +223,15 @@ _tabulated_verdict: Dict[str, bool] = {}
 _tabulated_lock = _threading.Lock()
 
 
+def invalidate_tabulated_profile() -> None:
+    """Drop the cached tabulated-vs-ladder verdict.  The profile is timed
+    AT the live commit bucket shape, so a validator-set size change that
+    moves the bucket can flip the break-even — TableCache.rebuild calls
+    this when the set size changes and the next dispatch re-profiles."""
+    with _tabulated_lock:
+        _tabulated_verdict.clear()
+
+
 def _timed(fn) -> float:
     import time as _time
 
@@ -581,6 +590,21 @@ class BatchVerifier:
         if self.min_device_batch < self._NEVER_DEVICE:
             self._bucket_ready(self._bucket(max(1, self.min_device_batch)))
         return self
+
+    def rewarm(self, n: int) -> None:
+        """Re-probe the warmup bucket for an expected batch size of `n`
+        signatures (a validator-set size change): start_warmup compiled
+        the bucket for min_device_batch, but a grown set's commit batch
+        lands in a LARGER bucket that was never compiled — without this
+        the first post-rotation commit eats a live XLA compile behind a
+        node that believes itself warm.  No-op when warmup mode is off,
+        when n routes to the host tier, or when the bucket is already
+        ready/compiling."""
+        if not self._warmup_mode or self.min_device_batch >= self._NEVER_DEVICE:
+            return
+        if n < self.min_device_batch:
+            return
+        self._bucket_ready(self._bucket(n))
 
     def _use_pallas(self) -> bool:
         if self._pallas is None:
@@ -1162,6 +1186,66 @@ class TableCache:
         state (cache hit) never needs the rows, so hot callers pass a
         callable and skip building a V-sized list per commit."""
         return pubkeys() if callable(pubkeys) else pubkeys
+
+    def has_table(self, set_key: bytes) -> bool:
+        with self._lock:
+            return set_key in self._tables
+
+    def rebuild(self, set_key: bytes, pubkeys: Sequence[bytes]) -> bool:
+        """Proactively (re)build the device table for a validator set —
+        the node's EVENT_VALIDATOR_SET_UPDATES subscriber calls this the
+        moment an update lands so the table for the INCOMING set is warm
+        before its first commit arrives, instead of that commit paying
+        the decline-while-building miss.  Also re-probes the warmup
+        bucket and, when the set size changed, invalidates the tabulated
+        break-even profile (both are shaped by the commit batch size).
+
+        Returns True when a background build was kicked off; False when
+        the set's table is already cached or building."""
+        import time as _time
+
+        pk_copy = [bytes(pk) for pk in self._rows(pubkeys)]
+        n = len(pk_copy)
+        with self._lock:
+            known_sizes = {len(tab.pubkeys) for tab in self._tables.values()}
+            if set_key in self._tables or set_key in self._building:
+                # table already live/underway; the bucket may still be stale
+                self.verifier.rewarm(n)
+                return False
+            self._building.add(set_key)
+        if known_sizes and n not in known_sizes:
+            invalidate_tabulated_profile()
+        self.verifier.rewarm(n)
+        t0 = _time.perf_counter()
+
+        def _build():
+            ok = False
+            try:
+                tab = self.table_for(set_key, pk_copy)
+                # warm the dispatch at the whole-commit shape (one row per
+                # validator — what verify_commit sends at steady state)
+                tab.verify_indexed(
+                    list(range(n)), [b"warmup"] * n, [bytes(64)] * n
+                )
+                ok = True
+            except Exception:
+                pass
+            finally:
+                with self._lock:
+                    self._building.discard(set_key)
+            self.verifier.metrics.table_rebuilds.inc()
+            self.verifier.recorder.record(
+                "verify.table_rebuild",
+                set_key=set_key.hex()[:16],
+                validators=n,
+                ms=round((_time.perf_counter() - t0) * 1000, 3),
+                ok=ok,
+                shards=self.verifier.shards,
+            )
+
+        # non-daemon for the same reason as the warmup threads above
+        _threading.Thread(target=_build, daemon=False, name="table-rebuild").start()
+        return True
 
     def install(self) -> "TableCache":
         batch_hook.set_indexed_verifier(self.verify_indexed)

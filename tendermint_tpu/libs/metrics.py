@@ -305,13 +305,25 @@ class StateMetrics:
     def __init__(self, registry=None, chain_id: str = ""):
         if registry is None:
             self.block_processing_time = _NOP
+            self.valset_updates = _NOP
+            self.valset_size = _NOP
             return
-        from prometheus_client import Histogram
+        from prometheus_client import Counter, Gauge, Histogram
 
         self.block_processing_time = Histogram(
             "block_processing_time", "Time between BeginBlock and EndBlock in ms.",
             namespace=NAMESPACE, subsystem="state", registry=registry,
             labelnames=("chain_id",), buckets=[1 * i for i in range(1, 11)] + [20, 50, 100, 500],
+        ).labels(chain_id=chain_id)
+        kw = dict(namespace=NAMESPACE, subsystem="state", registry=registry,
+                  labelnames=("chain_id",))
+        self.valset_updates = Counter(
+            "valset_updates",
+            "ABCI validator-set update events applied (end_block → update_state).",
+            **kw,
+        ).labels(chain_id=chain_id)
+        self.valset_size = Gauge(
+            "valset_size", "Validators in the upcoming (next) validator set.", **kw
         ).labels(chain_id=chain_id)
 
 
@@ -329,7 +341,8 @@ class VerifyMetrics:
             for name in (
                 "batch_size", "queue_wait_seconds", "host_prep_seconds",
                 "device_seconds", "flush_quantum_seconds", "bucket_compiles",
-                "table_cache_hits", "table_cache_misses", "backend_tier",
+                "table_cache_hits", "table_cache_misses", "table_rebuilds",
+                "backend_tier",
                 "shards", "bls_agg_seconds", "bls_agg_checks", "bls_tier",
             ):
                 setattr(self, name, _NOP)
@@ -378,6 +391,10 @@ class VerifyMetrics:
         )
         self.table_cache_misses = c(
             "table_cache_misses", "Indexed verifies that had to build (or decline to) a table."
+        )
+        self.table_rebuilds = c(
+            "table_rebuilds",
+            "Proactive pubkey-table (re)builds triggered by validator-set updates.",
         )
         self.backend_tier = g(
             "backend_tier",

@@ -109,11 +109,11 @@ class Node(Service):
         creator = client_creator or default_client_creator(
             config.base.proxy_app,
             config.base.abci,
-            # opened only for the builtin kvstore — a socket/gRPC app must
-            # not grow a stray empty db under home/data
+            # opened only for the builtin stateful apps — a socket/gRPC app
+            # must not grow a stray empty db under home/data
             app_db=(
                 self._wrap_db(open_db("app", home, backend), "app")
-                if config.base.proxy_app == "kvstore"
+                if config.base.proxy_app in ("kvstore", "bank", "staking")
                 else None
             ),
             snapshot_interval=config.statesync.snapshot_interval,
@@ -422,6 +422,11 @@ class Node(Service):
         if self.priv_validator is not None:
             self.consensus.set_priv_validator(self.priv_validator)
         self.consensus.storage_health = self.storage_health
+        # dynamic validator sets: rebuild the verify engine's device tables
+        # (and re-probe warmup buckets) the moment an ABCI update lands, so
+        # the INCOMING set's first commit verifies through a warm table
+        # instead of paying the decline-while-building miss
+        self.spawn(self._valset_watch(), name="valset-watch")
         cfg.ensure_dirs()
         if cfg.base.db_backend != "memdb":
             self.consensus.wal = WAL(cfg.wal_file())
@@ -697,6 +702,63 @@ class Node(Service):
             chain_id=self.genesis_doc.chain_id,
             height=self.state.last_block_height,
         )
+
+    async def _valset_watch(self) -> None:
+        """Subscribe to EVENT_VALIDATOR_SET_UPDATES and keep every
+        set-parameterized engine layer current:
+
+        - gauges (`valset_updates_total`, `valset_size`) + a `valset.update`
+          flight-recorder event so rotations are attributable post-mortem;
+        - TableCache.rebuild for the upcoming set's pubkey digest — the
+          replicated device table is otherwise built lazily on first miss,
+          which would put a seconds-long build on the first post-rotation
+          commit; a pure/mixed-BLS set skips the table (the indexed path
+          only engages for all-ed25519 commits) but still re-probes the
+          warmup bucket for the new set size.
+        """
+        from .libs.events import SubscriptionCancelled
+        from .types.events import EVENT_VALIDATOR_SET_UPDATES, query_for_event
+        from .types.vote import is_bls_key
+
+        sub = await self.event_bus.subscribe(
+            "node-valset-watch", query_for_event(EVENT_VALIDATOR_SET_UPDATES)
+        )
+        while True:
+            try:
+                msg = await sub.next()
+            except (SubscriptionCancelled, asyncio.CancelledError):
+                return
+            try:
+                event = msg.data
+                updates = (getattr(event, "data", None) or {}).get("validator_updates", [])
+                # the executor saves state (with the H+2 set in
+                # next_validators) BEFORE firing events, so the store is
+                # the race-free source for the upcoming set
+                new_state = self.state_store.load()
+                next_vals = new_state.next_validators
+                self.metrics_provider.state.valset_updates.inc()
+                self.metrics_provider.state.valset_size.set(next_vals.size())
+                self.flight_recorder.record(
+                    "valset.update",
+                    height=new_state.last_block_height,
+                    n_updates=len(updates),
+                    new_size=next_vals.size(),
+                    uniform_bls=all(is_bls_key(v.pub_key) for v in next_vals.validators),
+                )
+                if self.table_cache is not None:
+                    all_ed = all(
+                        getattr(v.pub_key, "TYPE", "") == "tendermint/PubKeyEd25519"
+                        for v in next_vals.validators
+                    )
+                    if all_ed:
+                        self.table_cache.rebuild(
+                            next_vals.pubkeys_digest(),
+                            [v.pub_key.bytes() for v in next_vals.validators],
+                        )
+                    elif self.batch_verifier is not None:
+                        self.batch_verifier.rewarm(next_vals.size())
+            except Exception as e:
+                self.log.error("valset watch failed", err=repr(e))
 
     async def _start_liteserve(self) -> None:
         from .lite2 import HTTPProvider, LocalProvider, TrustOptions

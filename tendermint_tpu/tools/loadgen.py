@@ -91,6 +91,53 @@ def make_tx(key: Ed25519PrivKey, worker: int, seq: int, tx_bytes: int,
     return make_signed_tx(key, payload) if signed else payload
 
 
+# All bank-mode workers credit ONE hot account: maximal write contention
+# on a single balance while each sender keeps its own nonce lane.
+_HOT_ACCOUNT = Ed25519PrivKey.from_secret(b"loadgen-hot-account").pub_key().address()
+
+# 1-in-N bank txs deliberately overdraft, so the run exercises REAL
+# app-level rejections (CODE_INSUFFICIENT_FUNDS) — not just happy-path
+# accepts — and the classifier's app:<code> split is visibly non-empty.
+_BANK_OVERDRAFT_EVERY = 50
+
+
+def make_bank_tx(key: Ed25519PrivKey, seq: int, fee: int = 0) -> bytes:
+    """A signed bank transfer to the shared hot account.  The overdraft
+    probe sends an impossible amount on a schedule; its nonce is REUSED by
+    the next real transfer (a rejected tx never burns a nonce)."""
+    from ..apps.bank import make_transfer_tx
+
+    nonce = seq - seq // _BANK_OVERDRAFT_EVERY if _BANK_OVERDRAFT_EVERY else seq
+    if _BANK_OVERDRAFT_EVERY and seq % _BANK_OVERDRAFT_EVERY == _BANK_OVERDRAFT_EVERY - 1:
+        return make_transfer_tx(key, _HOT_ACCOUNT, 1 << 62, nonce, fee=fee)
+    return make_transfer_tx(key, _HOT_ACCOUNT, 1, nonce, fee=fee)
+
+
+async def _bank_start_seq(session: aiohttp.ClientSession, url: str,
+                          key: Ed25519PrivKey) -> int:
+    """Resume a worker's nonce lane from the chain (abci_query path=nonce)
+    so back-to-back loadgen runs against one chain keep accepting."""
+    req = {
+        "jsonrpc": "2.0", "id": 0, "method": "abci_query",
+        "params": {"path": "nonce",
+                   "data": {"@b": base64.b64encode(key.pub_key().address()).decode()}},
+    }
+    try:
+        async with session.post(url, json=req) as resp:
+            d = await resp.json(content_type=None)
+        value = ((d.get("result") or {}).get("response") or {}).get("value")
+        if isinstance(value, dict):
+            value = base64.b64decode(value.get("@b", ""))
+        nonce = int(value or b"0")
+    except (aiohttp.ClientError, asyncio.TimeoutError, ValueError, TypeError):
+        return 0
+    # invert nonce -> seq: every full overdraft period consumes one extra
+    # seq without consuming a nonce
+    if _BANK_OVERDRAFT_EVERY:
+        return nonce + nonce // (_BANK_OVERDRAFT_EVERY - 1)
+    return nonce
+
+
 async def _worker(
     wid: int,
     session: aiohttp.ClientSession,
@@ -104,8 +151,9 @@ async def _worker(
     signed: bool,
 ) -> None:
     key = Ed25519PrivKey.from_secret(b"loadgen-%d" % wid)
-    method = f"broadcast_tx_{mode}"
-    seq = 0
+    bank = mode == "bank"
+    method = "broadcast_tx_sync" if bank else f"broadcast_tx_{mode}"
+    seq = await _bank_start_seq(session, targets[0], key) if bank else 0
     next_send = time.monotonic()
     while time.monotonic() < deadline:
         if per_worker_rate > 0:
@@ -113,7 +161,11 @@ async def _worker(
             if now < next_send:
                 await asyncio.sleep(next_send - now)
             next_send += 1.0 / per_worker_rate
-        tx = make_tx(key, wid, seq, tx_bytes, fee=fee, signed=signed)
+        tx = (
+            make_bank_tx(key, seq, fee=fee)
+            if bank
+            else make_tx(key, wid, seq, tx_bytes, fee=fee, signed=signed)
+        )
         seq += 1
         url = targets[seq % len(targets)]
         req = {
@@ -353,7 +405,9 @@ def main(argv=None) -> int:
                     help="total offered tx/sec (0 = as fast as possible)")
     ap.add_argument("--connections", type=int, default=8)
     ap.add_argument("--tx-bytes", type=int, default=192)
-    ap.add_argument("--mode", choices=["sync", "async"], default="sync")
+    ap.add_argument("--mode", choices=["sync", "async", "bank"], default="sync",
+                    help="broadcast flavor; 'bank' sends contended signed "
+                         "transfers (needs proxy_app = bank or staking)")
     ap.add_argument("--fee", type=int, default=0,
                     help="fee:<n>: priority prefix on every payload")
     ap.add_argument("--plain", action="store_true",

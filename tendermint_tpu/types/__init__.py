@@ -59,7 +59,7 @@ from .part_set import Part, PartSet
 from .evidence import DuplicateVoteEvidence, Evidence, evidence_hash
 from .tx import tx_hash, txs_hash, TxProof, tx_proof, ABCIResult, results_hash
 from .genesis import GenesisDoc, GenesisValidator
-from .priv_validator import PrivValidator, MockPV
+from .priv_validator import PrivValidator, MockPV, RotatingPV
 from .events import EventBus, Event
 
 __all__ = [n for n in dir() if not n.startswith("_")]

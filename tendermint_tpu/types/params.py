@@ -18,10 +18,12 @@ MAX_BLOCK_PARTS_COUNT = MAX_BLOCK_SIZE_BYTES // BLOCK_PART_SIZE_BYTES + 1
 ABCI_PUBKEY_TYPE_ED25519 = "ed25519"
 ABCI_PUBKEY_TYPE_SR25519 = "sr25519"
 ABCI_PUBKEY_TYPE_SECP256K1 = "secp256k1"
+ABCI_PUBKEY_TYPE_BLS12381 = "bls12381"
 KNOWN_ABCI_PUBKEY_TYPES = (
     ABCI_PUBKEY_TYPE_ED25519,
     ABCI_PUBKEY_TYPE_SR25519,
     ABCI_PUBKEY_TYPE_SECP256K1,
+    ABCI_PUBKEY_TYPE_BLS12381,
 )
 
 
@@ -40,7 +42,10 @@ class EvidenceParams:
 
 @dataclass(frozen=True)
 class ValidatorParams:
-    pub_key_types: tuple = (ABCI_PUBKEY_TYPE_ED25519,)
+    # ed25519 + bls12381 by default so an ABCI-driven ed25519↔BLS set
+    # migration needs no genesis param change.  ConsensusParams.hash()
+    # covers only block params, so widening the default is hash-safe.
+    pub_key_types: tuple = (ABCI_PUBKEY_TYPE_ED25519, ABCI_PUBKEY_TYPE_BLS12381)
 
     def is_valid_pubkey_type(self, t: str) -> bool:
         return t in self.pub_key_types

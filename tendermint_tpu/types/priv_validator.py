@@ -44,6 +44,61 @@ class PrivValidator(ABC):
         raise NotImplementedError
 
 
+class RotatingPV(PrivValidator):
+    """A multi-key privval for live consensus-key migrations.
+
+    Holds an ordered list of candidate signers (e.g. the node's ed25519
+    FilePV/MockPV plus a BLS12-381 one) and signs with whichever key is a
+    member of the CURRENT validator set — consensus notifies it at every
+    height boundary via `observe_validators` (consensus/state.py
+    update_to_state), which is exactly when an ABCI-driven rotation
+    becomes effective.  Until a set containing one of its keys is
+    observed, the first candidate is active (the pre-migration identity).
+
+    Double-sign safety is inherited: each candidate signer keeps its own
+    last-signed state, and at any given height exactly one candidate's
+    address is in the set (the staking app's rotate tx swaps the old key
+    out and the new key in atomically in one end_block).
+    """
+
+    def __init__(self, *candidates: PrivValidator):
+        if not candidates:
+            raise ValueError("RotatingPV needs at least one candidate signer")
+        self.candidates = list(candidates)
+        self._active = candidates[0]
+
+    def observe_validators(self, val_set) -> None:
+        for pv in self.candidates:
+            if val_set.has_address(pv.get_pub_key().address()):
+                self._active = pv
+                return
+        # none of our keys is in the set: keep the current signer (the
+        # node is simply not a validator right now — consensus membership
+        # checks handle that; switching would be arbitrary)
+
+    @property
+    def active(self) -> PrivValidator:
+        return self._active
+
+    def get_pub_key(self) -> PubKey:
+        return self._active.get_pub_key()
+
+    def address(self) -> bytes:
+        return self.get_pub_key().address()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        self._active.sign_vote(chain_id, vote)
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        self._active.sign_proposal(chain_id, proposal)
+
+    def sign_challenge(self, nonce: bytes) -> bytes:
+        return self._active.sign_challenge(nonce)
+
+    def __repr__(self) -> str:
+        return f"RotatingPV(active={self._active!r}, n={len(self.candidates)})"
+
+
 class MockPV(PrivValidator):
     """In-memory signer for tests (types/priv_validator.go:33).
     `break_*` flags corrupt sign-bytes for byzantine tests

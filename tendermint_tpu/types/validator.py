@@ -367,6 +367,13 @@ class ValidatorSet:
         self._update_total_voting_power()
         self.rescale_priorities(PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())
         self._shift_by_avg_proposer_priority()
+        # The cached proposer may have been removed (stale pointer: a
+        # validator no longer in the set) or replaced by _apply_updates (a
+        # stale object: old power/priority).  Re-point it at the live entry,
+        # or clear it so get_proposer() recomputes from the new priorities.
+        if self.proposer is not None:
+            _, live = self.get_by_address(self.proposer.address)
+            self.proposer = live
 
     @staticmethod
     def _process_changes(orig_changes: List[Validator]) -> Tuple[List[Validator], List[Validator]]:

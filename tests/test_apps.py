@@ -1,0 +1,411 @@
+"""Bank + staking application unit tests.
+
+The bank app is the contended-state workload backend (nonces, fees,
+overdrafts); the staking app extends it with validator records whose
+end_block updates drive live set rotation.  These tests pin the tx
+grammar, the rejection codes, the end_block update emission (including
+the PoP gate on BLS rotations), epoch barrel-shift determinism, and
+record persistence across an app restart.
+"""
+
+import json
+
+import pytest
+
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.apps.bank import (
+    BankApplication,
+    CODE_BAD_NONCE,
+    CODE_BAD_SIG,
+    CODE_INSUFFICIENT_FUNDS,
+    CODE_MALFORMED,
+    CODE_OK,
+    DEFAULT_FAUCET,
+    make_transfer_tx,
+)
+from tendermint_tpu.apps.staking import (
+    CODE_BAD_POP,
+    CODE_KEY_IN_USE,
+    CODE_NO_VALIDATOR,
+    StakingApplication,
+    make_bond_tx,
+    make_edit_power_tx,
+    make_rotate_key_tx,
+    make_unbond_tx,
+)
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.libs.kvstore import MemDB
+
+
+def _key(seed: int) -> Ed25519PrivKey:
+    return Ed25519PrivKey.from_secret(bytes([seed]) * 32)
+
+
+def _addr(priv) -> bytes:
+    return priv.pub_key().address()
+
+
+def _block(app, height, *txs):
+    """Run txs through one begin/deliver/end/commit cycle; returns
+    (deliver responses, end_block validator updates)."""
+    app.begin_block(t.RequestBeginBlock())
+    res = [app.deliver_tx(t.RequestDeliverTx(tx=tx)) for tx in txs]
+    eb = app.end_block(t.RequestEndBlock(height=height))
+    app.commit()
+    return res, eb.validator_updates
+
+
+# -- bank -------------------------------------------------------------------
+
+
+def test_bank_transfer_moves_balance_and_debits_fee():
+    app = BankApplication()
+    a, b = _key(1), _key(2)
+    (res,), _ = _block(app, 1, make_transfer_tx(a, _addr(b), 100, 0, fee=7))
+    assert res.code == CODE_OK
+    assert app._account(_addr(a)) == (DEFAULT_FAUCET - 107, 1)
+    assert app._account(_addr(b)) == (DEFAULT_FAUCET + 100, 0)
+    assert app.fee_pool == 7
+
+
+def test_bank_nonces_strictly_sequential():
+    app = BankApplication()
+    a, b = _key(1), _key(2)
+    replay = make_transfer_tx(a, _addr(b), 1, 0)
+    (r0,), _ = _block(app, 1, replay)
+    assert r0.code == CODE_OK
+    # replaying nonce 0 and skipping to nonce 2 both fail; nonce 1 works
+    assert app.deliver_tx(t.RequestDeliverTx(tx=replay)).code == CODE_BAD_NONCE
+    skip = make_transfer_tx(a, _addr(b), 1, 2)
+    assert app.deliver_tx(t.RequestDeliverTx(tx=skip)).code == CODE_BAD_NONCE
+    ok = make_transfer_tx(a, _addr(b), 1, 1)
+    assert app.deliver_tx(t.RequestDeliverTx(tx=ok)).code == CODE_OK
+
+
+def test_bank_overdraft_rejected_checktx_and_delivertx():
+    app = BankApplication(faucet=50)
+    a, b = _key(1), _key(2)
+    tx = make_transfer_tx(a, _addr(b), 51, 0)
+    assert app.check_tx(t.RequestCheckTx(tx=tx)).code == CODE_INSUFFICIENT_FUNDS
+    assert app.deliver_tx(t.RequestDeliverTx(tx=tx)).code == CODE_INSUFFICIENT_FUNDS
+    # fee counts against the same balance
+    tx2 = make_transfer_tx(a, _addr(b), 45, 0, fee=6)
+    assert app.deliver_tx(t.RequestDeliverTx(tx=tx2)).code == CODE_INSUFFICIENT_FUNDS
+
+
+def test_bank_delivertx_verifies_signature():
+    app = BankApplication()
+    a, b = _key(1), _key(2)
+    tx = bytearray(make_transfer_tx(a, _addr(b), 10, 0))
+    tx[-1] ^= 0x01  # corrupt the payload after signing
+    assert app.deliver_tx(t.RequestDeliverTx(tx=bytes(tx))).code == CODE_BAD_SIG
+
+
+def test_bank_malformed_payloads_rejected():
+    app = BankApplication()
+    a = _key(1)
+    from tendermint_tpu.mempool import make_signed_tx
+
+    for payload in (b"bank:send:zz:1:0", b"bank:mint:00:1:0", b"noise"):
+        tx = make_signed_tx(a, payload)
+        assert app.deliver_tx(t.RequestDeliverTx(tx=tx)).code == CODE_MALFORMED
+    assert app.deliver_tx(t.RequestDeliverTx(tx=b"raw bytes")).code == CODE_MALFORMED
+
+
+def test_bank_self_transfer_conserves_balance():
+    app = BankApplication()
+    a = _key(1)
+    (res,), _ = _block(app, 1, make_transfer_tx(a, _addr(a), 500, 0))
+    assert res.code == CODE_OK
+    assert app._account(_addr(a)) == (DEFAULT_FAUCET, 1)
+
+
+def test_bank_apphash_deterministic_across_replicas():
+    txs = [
+        make_transfer_tx(_key(1), _addr(_key(2)), 10, 0, fee=1),
+        make_transfer_tx(_key(2), _addr(_key(3)), 20, 0),
+        make_transfer_tx(_key(1), _addr(_key(3)), 30, 1),
+    ]
+    hashes = []
+    for _ in range(2):
+        app = BankApplication()
+        _block(app, 1, *txs)
+        hashes.append(app.app_hash)
+    assert hashes[0] == hashes[1] and hashes[0]
+
+
+def test_bank_genesis_state_seeds_accounts_and_faucet():
+    app = BankApplication()
+    rich = _addr(_key(9))
+    state = json.dumps(
+        {"bank": {"faucet": 5, "accounts": {rich.hex(): 12345}}}
+    ).encode()
+    app.init_chain(t.RequestInitChain(app_state_bytes=state))
+    assert app.faucet == 5
+    assert app._account(rich) == (12345, 0)
+    assert app._account(_addr(_key(8))) == (5, 0)  # lazy faucet uses override
+
+
+def test_bank_query_paths():
+    app = BankApplication()
+    a, b = _key(1), _key(2)
+    _block(app, 1, make_transfer_tx(a, _addr(b), 10, 0, fee=3))
+    q = app.query(t.RequestQuery(path="balance", data=_addr(a)))
+    assert q.code == t.CODE_TYPE_OK and int(q.value) == DEFAULT_FAUCET - 13
+    q = app.query(t.RequestQuery(path="nonce", data=_addr(a)))
+    assert int(q.value) == 1
+    q = app.query(t.RequestQuery(path="fee_pool"))
+    assert int(q.value) == 3
+    assert app.query(t.RequestQuery(path="nope")).code != t.CODE_TYPE_OK
+
+
+# -- staking ----------------------------------------------------------------
+
+
+def _genesis_update(priv, power) -> t.ValidatorUpdate:
+    return t.ValidatorUpdate(
+        pub_key_type="ed25519", pub_key=priv.pub_key().bytes(), power=power
+    )
+
+
+def test_staking_init_chain_registers_genesis_validators():
+    app = StakingApplication()
+    g = _key(1)
+    app.init_chain(
+        t.RequestInitChain(
+            validators=[_genesis_update(g, 10)],
+            app_state_bytes=json.dumps({"staking": {"epoch_length": 16}}).encode(),
+        )
+    )
+    assert app.epoch_length == 16
+    rec = app.validators[_addr(g)]  # owner = the consensus key's address
+    assert rec["power"] == 10 and rec["pub_key"] == g.pub_key().bytes()
+
+
+def test_staking_bond_joins_and_emits_update():
+    app = StakingApplication()
+    owner = _key(5)
+    (res,), updates = _block(app, 1, make_bond_tx(owner, 40, 0))
+    assert res.code == CODE_OK
+    assert len(updates) == 1
+    vu = updates[0]
+    assert vu.pub_key_type == "ed25519"
+    assert vu.pub_key == owner.pub_key().bytes()  # envelope key is consensus key
+    assert vu.power == 40
+    # stake debited from the faucet-opened balance, nonce bumped
+    assert app._account(_addr(owner)) == (DEFAULT_FAUCET - 40, 1)
+    # bonding more adds power on the same record
+    _, updates = _block(app, 2, make_bond_tx(owner, 5, 1))
+    assert updates[0].power == 45
+
+
+def test_staking_bond_overdraft_rejected():
+    app = StakingApplication(faucet=30)
+    assert (
+        app.check_tx(t.RequestCheckTx(tx=make_bond_tx(_key(5), 31, 0))).code
+        == CODE_INSUFFICIENT_FUNDS
+    )
+
+
+def test_staking_unbond_partial_and_full():
+    app = StakingApplication()
+    owner = _key(5)
+    _block(app, 1, make_bond_tx(owner, 40, 0))
+    (res,), updates = _block(app, 2, make_unbond_tx(owner, 15, 1))
+    assert res.code == CODE_OK and updates[0].power == 25
+    assert app._account(_addr(owner)) == (DEFAULT_FAUCET - 25, 2)
+    # unbonding more than bonded is rejected
+    r = app.deliver_tx(t.RequestDeliverTx(tx=make_unbond_tx(owner, 26, 2)))
+    assert r.code == CODE_NO_VALIDATOR
+    # unbonding the rest leaves the set (power-0 update, record dropped)
+    _, updates = _block(app, 3, make_unbond_tx(owner, 25, 2))
+    assert updates[0].power == 0
+    assert _addr(owner) not in app.validators
+    assert app._account(_addr(owner)) == (DEFAULT_FAUCET, 3)  # fully refunded
+
+
+def test_staking_edit_power_settles_difference():
+    app = StakingApplication()
+    owner = _key(5)
+    _block(app, 1, make_bond_tx(owner, 40, 0))
+    _, updates = _block(app, 2, make_edit_power_tx(owner, 25, 1))
+    assert updates[0].power == 25
+    assert app._account(_addr(owner)) == (DEFAULT_FAUCET - 25, 2)
+    # edit to zero = leave with a full refund
+    _, updates = _block(app, 3, make_edit_power_tx(owner, 0, 2))
+    assert updates[0].power == 0 and _addr(owner) not in app.validators
+    assert app._account(_addr(owner)) == (DEFAULT_FAUCET, 3)
+
+
+def test_staking_verbs_require_bonded_validator():
+    app = StakingApplication()
+    owner = _key(5)
+    for tx in (
+        make_unbond_tx(owner, 1, 0),
+        make_edit_power_tx(owner, 1, 0),
+        make_rotate_key_tx(owner, "ed25519", _key(6).pub_key().bytes(), 0),
+    ):
+        assert app.deliver_tx(t.RequestDeliverTx(tx=tx)).code == CODE_NO_VALIDATOR
+
+
+def test_staking_bond_rejects_consensus_key_held_by_other_owner():
+    app = StakingApplication()
+    a, b = _key(5), _key(6)
+    _block(app, 1, make_bond_tx(a, 10, 0))
+    # owner a rotates to a foreign ed25519 key == b's envelope key
+    _block(app, 2, make_rotate_key_tx(a, "ed25519", b.pub_key().bytes(), 1))
+    r = app.deliver_tx(t.RequestDeliverTx(tx=make_bond_tx(b, 10, 0)))
+    assert r.code == CODE_KEY_IN_USE
+
+
+def test_staking_rotate_to_bls_requires_valid_pop():
+    pytest.importorskip("tendermint_tpu.crypto.bls.keys")
+    from tendermint_tpu.crypto.bls.keys import BlsPrivKey
+
+    app = StakingApplication()
+    owner = _key(5)
+    _block(app, 1, make_bond_tx(owner, 40, 0))
+    bls = BlsPrivKey.from_secret(b"\x07" * 32)
+    pub = bls.pub_key().bytes()
+    # no PoP
+    r = app.deliver_tx(
+        t.RequestDeliverTx(tx=make_rotate_key_tx(owner, "bls12381", pub, 1))
+    )
+    assert r.code == CODE_BAD_POP
+    # PoP for a different key
+    other_pop = BlsPrivKey.from_secret(b"\x08" * 32).pop()
+    r = app.deliver_tx(
+        t.RequestDeliverTx(
+            tx=make_rotate_key_tx(owner, "bls12381", pub, 1, pop=other_pop)
+        )
+    )
+    assert r.code == CODE_BAD_POP
+    # valid PoP: old key exits at power 0, new key enters at full power
+    (res,), updates = _block(
+        app, 2, make_rotate_key_tx(owner, "bls12381", pub, 1, pop=bls.pop())
+    )
+    assert res.code == CODE_OK
+    by_key = {vu.pub_key: vu for vu in updates}
+    assert by_key[owner.pub_key().bytes()].power == 0
+    assert by_key[pub].power == 40 and by_key[pub].pub_key_type == "bls12381"
+    assert by_key[pub].pop == bls.pop()
+    # rotating back to ed25519 needs no PoP and restores the old identity
+    _, updates = _block(
+        app, 3, make_rotate_key_tx(owner, "ed25519", owner.pub_key().bytes(), 2)
+    )
+    by_key = {vu.pub_key: vu for vu in updates}
+    assert by_key[pub].power == 0
+    assert by_key[owner.pub_key().bytes()].power == 40
+
+
+def test_staking_rotate_rejects_key_in_use_and_bad_lengths():
+    app = StakingApplication()
+    a, b = _key(5), _key(6)
+    _block(app, 1, make_bond_tx(a, 10, 0), make_bond_tx(b, 10, 0))
+    r = app.deliver_tx(
+        t.RequestDeliverTx(tx=make_rotate_key_tx(a, "ed25519", b.pub_key().bytes(), 1))
+    )
+    assert r.code == CODE_KEY_IN_USE
+    r = app.deliver_tx(
+        t.RequestDeliverTx(tx=make_rotate_key_tx(a, "ed25519", b"\x01" * 31, 1))
+    )
+    assert r.code != CODE_OK
+    r = app.deliver_tx(
+        t.RequestDeliverTx(tx=make_rotate_key_tx(a, "sr25519", b"\x01" * 32, 1))
+    )
+    assert r.code != CODE_OK
+
+
+def test_staking_epoch_barrel_shift_is_deterministic():
+    def build():
+        app = StakingApplication(epoch_length=4)
+        _block(
+            app,
+            1,
+            make_bond_tx(_key(1), 10, 0),
+            make_bond_tx(_key(2), 20, 0),
+            make_bond_tx(_key(3), 30, 0),
+        )
+        return app
+
+    a, b = build(), build()
+    # non-boundary heights emit nothing
+    assert _block(a, 2)[1] == [] and _block(b, 2)[1] == []
+    assert _block(a, 3)[1] == [] and _block(b, 3)[1] == []
+    ua = _block(a, 4)[1]
+    ub = _block(b, 4)[1]
+    assert ua == ub and ua  # identical on every replica
+    # the multiset of powers is preserved — only the assignment permutes
+    assert sorted(r["power"] for r in a.validators.values()) == [10, 20, 30]
+    assert [r["power"] for r in a.validators.values()] != [
+        r["power"] for r in b.validators.values()
+    ] or a.app_hash == b.app_hash
+    # another epoch keeps shifting; 3 validators -> period 3
+    _block(a, 5)
+    _block(a, 6)
+    _block(a, 7)
+    u8 = _block(a, 8)[1]
+    assert u8
+    for _ in range(4):
+        for h in range(9, 13):
+            _block(a, h)
+    # app hash stays deterministic through epochs
+    assert a.app_hash
+
+
+def test_staking_epoch_noop_for_single_validator():
+    app = StakingApplication(epoch_length=2)
+    _block(app, 1, make_bond_tx(_key(1), 10, 0))
+    assert _block(app, 2)[1] == []
+
+
+def test_staking_records_persist_across_restart():
+    db = MemDB()
+    app = StakingApplication(db=db)
+    app.init_chain(
+        t.RequestInitChain(
+            app_state_bytes=json.dumps({"staking": {"epoch_length": 8}}).encode()
+        )
+    )
+    owner = _key(5)
+    _block(app, 1, make_bond_tx(owner, 40, 0))
+    app2 = StakingApplication(db=db)
+    assert app2.epoch_length == 8  # persisted at init_chain
+    rec = app2.validators[_addr(owner)]
+    assert rec["power"] == 40 and rec["pub_key"] == owner.pub_key().bytes()
+    assert app2.by_pubkey[owner.pub_key().bytes()] == _addr(owner)
+    assert app2.app_hash == app.app_hash
+
+
+def test_staking_query_paths():
+    app = StakingApplication()
+    owner = _key(5)
+    _block(app, 1, make_bond_tx(owner, 40, 0))
+    q = app.query(t.RequestQuery(path="validator", data=_addr(owner)))
+    assert q.code == t.CODE_TYPE_OK
+    rec = json.loads(q.value)
+    assert rec["power"] == 40 and rec["key_type"] == "ed25519"
+    q = app.query(t.RequestQuery(path="validators"))
+    assert _addr(owner).hex() in json.loads(q.value)
+    # bank query paths still work through the staking app
+    q = app.query(t.RequestQuery(path="nonce", data=_addr(owner)))
+    assert int(q.value) == 1
+    assert app.query(t.RequestQuery(path="validator", data=b"\x00" * 20)).code != 0
+
+
+def test_staking_state_digest_covers_validator_records():
+    a, b = StakingApplication(), StakingApplication()
+    _block(a, 1, make_bond_tx(_key(5), 40, 0))
+    _block(b, 1, make_bond_tx(_key(5), 41, 0))
+    assert a.app_hash != b.app_hash
+
+
+def test_staking_bank_transfers_still_flow():
+    app = StakingApplication()
+    a, b = _key(1), _key(2)
+    (r0, r1), updates = _block(
+        app, 1, make_transfer_tx(a, _addr(b), 10, 0), make_bond_tx(a, 5, 1)
+    )
+    assert r0.code == CODE_OK and r1.code == CODE_OK
+    assert len(updates) == 1 and updates[0].power == 5
+    assert app._account(_addr(a)) == (DEFAULT_FAUCET - 15, 2)
